@@ -1,0 +1,17 @@
+"""lodestar_tpu — a TPU-native Ethereum consensus framework.
+
+A from-scratch rebuild of the capabilities of ChainSafe Lodestar (reference:
+/root/reference, v1.1.1) designed TPU-first: the consensus state transition and
+fork choice are pure Python/numpy over flat arrays, SSZ merkleization is backed
+by a batched hashing layer, and the hot path — BLS12-381 batch signature
+verification (reference: packages/beacon-node/src/chain/bls/) — runs as
+vmapped XLA kernels on TPU with a pure-Python bigint tier as fallback and
+correctness oracle.
+
+Layering (mirrors SURVEY.md §1, bottom-up):
+  params -> utils -> ssz -> types -> config -> ops/bls/parallel ->
+  state_transition / fork_choice -> db -> api -> chain/network/sync ->
+  validator / light_client -> cli
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Spec presets, constants, and fork names (layer L0).
+
+Equivalent of the reference package `@lodestar/params`
+(/root/reference/packages/params). The active preset defaults to ``mainnet``
+and may be overridden by the ``LODESTAR_TPU_PRESET`` environment variable
+(the reference uses ``LODESTAR_PRESET``: params/src/setPreset.ts) or by
+calling :func:`set_active_preset` before any consensus objects are built.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .constants import *  # noqa: F401,F403
+from .fork_name import EXECUTION_FORKS, FORK_ORDER, ForkName, ForkSeq, fork_seq  # noqa: F401
+from .presets import MAINNET, MINIMAL, PRESETS, Preset  # noqa: F401
+
+ACTIVE_PRESET: Preset = PRESETS.get(os.environ.get("LODESTAR_TPU_PRESET", "mainnet"), MAINNET)
+
+
+def set_active_preset(name_or_preset: str | Preset) -> Preset:
+    """Override the process-default preset (call before building any state).
+
+    Mirrors `setActivePreset` in the reference (params/src/setPreset.ts); unlike
+    the reference we do not hard-fail on late calls because all consensus code
+    receives its preset through the BeaconConfig object rather than via module
+    globals — this only changes the *default*.
+    """
+    global ACTIVE_PRESET
+    preset = PRESETS[name_or_preset] if isinstance(name_or_preset, str) else name_or_preset
+    ACTIVE_PRESET = preset
+    return preset

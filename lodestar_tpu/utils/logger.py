@@ -1,0 +1,37 @@
+"""Module-scoped logger.
+
+Equivalent role of /root/reference/packages/utils/src/logger/winston.ts:
+child loggers scoped by module name with a uniform format. Built on stdlib
+``logging`` instead of winston.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
+_configured = False
+
+
+def _ensure_configured() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+    root = logging.getLogger("lodestar_tpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(module: str, level: int | None = None) -> logging.Logger:
+    """Child logger for a module (reference's LogModule enum, e.g. 'chain',
+    'network', 'sync' — beacon-node/src/node/nodejs.ts:60-71)."""
+    _ensure_configured()
+    logger = logging.getLogger(f"lodestar_tpu.{module}")
+    if level is not None:
+        logger.setLevel(level)
+    return logger

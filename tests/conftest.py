@@ -1,0 +1,27 @@
+"""Test harness setup.
+
+Tests run on a virtual 8-device CPU mesh (no TPU needed): the env vars must be
+set before jax initializes its backends. Multi-chip sharding paths are
+exercised against this mesh; the driver's `dryrun_multichip` does the same.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devices = np.array(jax.devices("cpu")[:8])
+    return Mesh(devices.reshape(8), axis_names=("dp",))

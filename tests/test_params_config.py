@@ -1,0 +1,83 @@
+"""Unit tests for params + config layers (reference: packages/params/test,
+packages/config/test)."""
+
+from lodestar_tpu.config import (
+    MAINNET_CHAIN_CONFIG,
+    NETWORK_CONFIGS,
+    BeaconConfig,
+    ChainForkConfig,
+    compute_domain,
+    compute_fork_digest,
+)
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    FAR_FUTURE_EPOCH,
+    MAINNET,
+    MINIMAL,
+    ForkName,
+    ForkSeq,
+)
+
+
+def test_preset_values():
+    assert MAINNET.SLOTS_PER_EPOCH == 32
+    assert MAINNET.SHUFFLE_ROUND_COUNT == 90
+    assert MAINNET.SYNC_COMMITTEE_SIZE == 512
+    assert MINIMAL.SLOTS_PER_EPOCH == 8
+    assert MINIMAL.SHUFFLE_ROUND_COUNT == 10
+    assert MAINNET.SYNC_COMMITTEE_SUBNET_SIZE == 128
+
+
+def test_fork_order():
+    assert ForkSeq[ForkName.phase0] < ForkSeq[ForkName.altair] < ForkSeq[ForkName.bellatrix]
+
+
+def test_fork_schedule_mainnet():
+    cfg = ChainForkConfig(MAINNET_CHAIN_CONFIG)
+    assert cfg.get_fork_name_at_epoch(0) == ForkName.phase0
+    assert cfg.get_fork_name_at_epoch(74239) == ForkName.phase0
+    assert cfg.get_fork_name_at_epoch(74240) == ForkName.altair
+    assert cfg.get_fork_name_at_epoch(144896) == ForkName.bellatrix
+    assert cfg.get_fork_name_at_epoch(194048) == ForkName.capella
+    assert cfg.get_fork_name_at_slot(74240 * 32) == ForkName.altair
+    # attribute fall-through: preset and chain config both reachable
+    assert cfg.SLOTS_PER_EPOCH == 32
+    assert cfg.SECONDS_PER_SLOT == 12
+
+
+def test_fork_schedule_dev_all_at_genesis():
+    cfg = ChainForkConfig(NETWORK_CONFIGS["dev"])
+    assert cfg.get_fork_name_at_epoch(0) == ForkName.capella
+
+
+def test_domain_computation_deterministic():
+    gvr = b"\x2a" * 32
+    cfg = BeaconConfig(MAINNET_CHAIN_CONFIG, gvr)
+    d1 = cfg.get_domain(DOMAIN_BEACON_PROPOSER, slot=0)
+    d2 = compute_domain(DOMAIN_BEACON_PROPOSER, MAINNET_CHAIN_CONFIG.GENESIS_FORK_VERSION, gvr)
+    assert d1 == d2
+    assert d1[:4] == DOMAIN_BEACON_PROPOSER
+    assert len(d1) == 32
+    # different domain types differ only in prefix
+    d3 = cfg.get_domain(DOMAIN_BEACON_ATTESTER, slot=0)
+    assert d3[4:] == d1[4:] and d3[:4] != d1[:4]
+    # domain for a post-fork epoch uses the new fork version
+    d4 = cfg.get_domain(DOMAIN_BEACON_PROPOSER, slot=74240 * 32)
+    assert d4 != d1
+
+
+def test_fork_digest():
+    gvr = b"\x01" * 32
+    cfg = BeaconConfig(MAINNET_CHAIN_CONFIG, gvr)
+    digest = cfg.fork_digest(ForkName.phase0)
+    assert len(digest) == 4
+    assert cfg.fork_name_from_digest(digest) == ForkName.phase0
+    assert digest == compute_fork_digest(MAINNET_CHAIN_CONFIG.GENESIS_FORK_VERSION, gvr)
+
+
+def test_far_future_forks_not_scheduled():
+    cfg = ChainForkConfig(NETWORK_CONFIGS["minimal"])
+    scheduled = [f.name for f in cfg.get_scheduled_forks()]
+    assert scheduled == [ForkName.phase0]
+    assert cfg.forks[ForkName.altair].epoch == FAR_FUTURE_EPOCH
