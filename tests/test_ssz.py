@@ -1,0 +1,207 @@
+"""SSZ engine tests (reference analog: @chainsafe/ssz test suite +
+ssz_generic spec-test categories)."""
+
+import pytest
+
+from lodestar_tpu.config import compute_fork_data_root
+from lodestar_tpu.ssz import (
+    BitListType,
+    BitVectorType,
+    Bytes4,
+    Bytes32,
+    ByteListType,
+    Container,
+    ContainerType,
+    DeserializationError,
+    ListType,
+    UnionType,
+    VectorType,
+    ZERO_HASHES,
+    boolean,
+    hash_pair,
+    merkleize_chunks,
+    uint8,
+    uint16,
+    uint64,
+    uint256,
+)
+
+
+def test_uint_roundtrip_and_root():
+    assert uint64.serialize(1) == b"\x01" + b"\x00" * 7
+    assert uint64.deserialize(uint64.serialize(2**64 - 1)) == 2**64 - 1
+    assert uint64.hash_tree_root(0) == b"\x00" * 32
+    assert uint16.serialize(0x0102) == b"\x02\x01"
+    with pytest.raises(ValueError):
+        uint8.serialize(256)
+    with pytest.raises(DeserializationError):
+        uint64.deserialize(b"\x00" * 7)
+
+
+def test_boolean():
+    assert boolean.serialize(True) == b"\x01"
+    with pytest.raises(DeserializationError):
+        boolean.deserialize(b"\x02")
+
+
+def test_vector_uint_packing():
+    v = VectorType(uint64, 2)
+    # Two uint64s pack into a single 32-byte chunk -> root == padded chunk
+    root = v.hash_tree_root([1, 2])
+    expected = (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + b"\x00" * 16
+    assert root == expected
+    assert v.deserialize(v.serialize([1, 2])) == [1, 2]
+    with pytest.raises(ValueError):
+        v.serialize([1])
+
+
+def test_list_mixin_length():
+    t = ListType(uint64, 1024)
+    # empty list: root = mix_in_length(zero-subtree root, 0)
+    depth = 8  # 1024 uint64 = 256 chunks -> depth 8
+    assert t.hash_tree_root([]) == hash_pair(ZERO_HASHES[depth], (0).to_bytes(32, "little"))
+    vals = list(range(100))
+    assert t.deserialize(t.serialize(vals)) == vals
+
+
+def test_bitvector():
+    t = BitVectorType(10)
+    bits = [True, False] * 5
+    data = t.serialize(bits)
+    assert len(data) == 2
+    assert t.deserialize(data) == bits
+    # nonzero padding must be rejected
+    with pytest.raises(DeserializationError):
+        t.deserialize(b"\xff\xff")
+
+
+def test_bitlist_delimiter():
+    t = BitListType(8)
+    bits = [True, True, False, True, False, True, False, False]
+    assert t.serialize(bits) == bytes([0x2B, 0x01])
+    assert t.deserialize(bytes([0x2B, 0x01])) == bits
+    assert t.serialize([]) == b"\x01"
+    assert t.deserialize(b"\x01") == []
+    with pytest.raises(DeserializationError):
+        t.deserialize(b"\x00")  # no delimiter
+    with pytest.raises(DeserializationError):
+        t.deserialize(b"")
+    with pytest.raises(DeserializationError):
+        t.deserialize(bytes([0x2B, 0x01, 0x00]))  # excess bytes
+    # bitlist root differs from bitvector root (length mix-in)
+    assert t.hash_tree_root(bits) != BitVectorType(8).hash_tree_root(bits)
+
+
+def test_bytelist_limits():
+    t = ByteListType(10)
+    assert t.deserialize(t.serialize(b"hello")) == b"hello"
+    with pytest.raises(ValueError):
+        t.serialize(b"x" * 11)
+
+
+class ForkData(Container):
+    fields = [("current_version", Bytes4), ("genesis_validators_root", Bytes32)]
+
+
+def test_container_fork_data_matches_config_handroll():
+    """The config layer hand-rolls ForkData's root (beacon_config.py) — the
+    generic SSZ container must agree."""
+    version = bytes.fromhex("01000000")
+    gvr = b"\x42" * 32
+    fd = ForkData(current_version=version, genesis_validators_root=gvr)
+    assert fd.hash_tree_root() == compute_fork_data_root(version, gvr)
+    assert fd.serialize() == version + gvr
+    assert ForkData.deserialize(fd.serialize()) == fd
+
+
+class Inner(Container):
+    fields = [("a", uint64), ("data", ByteListType(64))]
+
+
+class Outer(Container):
+    fields = [
+        ("x", uint16),
+        ("inner", Inner.ssz_type),
+        ("items", ListType(uint64, 32)),
+        ("fixed", Bytes4),
+    ]
+
+
+def test_variable_size_container_roundtrip():
+    o = Outer(
+        x=7,
+        inner=Inner(a=9, data=b"\xaa\xbb"),
+        items=[1, 2, 3],
+        fixed=b"\x01\x02\x03\x04",
+    )
+    data = o.serialize()
+    o2 = Outer.deserialize(data)
+    assert o2 == o
+    assert o2.inner.data == b"\xaa\xbb"
+    # fixed part: 2 (x) + 4 (offset inner) + 4 (offset items) + 4 (fixed) = 14
+    assert int.from_bytes(data[2:6], "little") == 14
+    # tamper with first offset -> rejected
+    bad = bytearray(data)
+    bad[2] = 13
+    with pytest.raises(DeserializationError):
+        Outer.deserialize(bytes(bad))
+
+
+def test_container_copy_is_deep():
+    o = Outer(x=1, inner=Inner(a=2, data=b"z"), items=[5], fixed=b"\x00" * 4)
+    c = o.copy()
+    c.inner.a = 99
+    c.items.append(6)
+    assert o.inner.a == 2
+    assert o.items == [5]
+
+
+def test_list_of_containers():
+    t = ListType(Inner.ssz_type, 4)
+    vals = [Inner(a=1, data=b"x"), Inner(a=2, data=b"yy")]
+    out = t.deserialize(t.serialize(vals))
+    assert out == vals
+    # root = mix_in_length(merkleize([htr(e)...], limit=4), 2)
+    roots = b"".join(v.hash_tree_root() for v in vals)
+    assert t.hash_tree_root(vals) == hash_pair(
+        merkleize_chunks(roots, limit=4), (2).to_bytes(32, "little")
+    )
+
+
+def test_union():
+    t = UnionType([None, uint64])
+    assert t.deserialize(t.serialize((1, 5))) == (1, 5)
+    assert t.deserialize(t.serialize((0, None))) == (0, None)
+    with pytest.raises(DeserializationError):
+        t.deserialize(b"\x05")
+
+
+def test_uint256():
+    v = 2**255 - 19
+    assert uint256.deserialize(uint256.serialize(v)) == v
+    assert uint256.hash_tree_root(v) == v.to_bytes(32, "little")
+
+
+def test_merkleize_virtual_padding_scales():
+    # limit 2**40 (validator registry) must not materialize chunks
+    root = merkleize_chunks(b"\x11" * 32, limit=2**40)
+    assert len(root) == 32
+    # equals hashing up 40 levels with zero siblings
+    acc = b"\x11" * 32
+    for d in range(40):
+        acc = hash_pair(acc, ZERO_HASHES[d])
+    assert root == acc
+
+
+def test_list_varsize_rejects_zero_first_offset():
+    # regression: first offset 0 must not be read as "empty list"
+    t = ListType(ByteListType(100), 10)
+    with pytest.raises(DeserializationError):
+        t.deserialize(b"\x00\x00\x00\x00\xff\xff\xff")
+
+
+def test_union_none_only_first_option():
+    with pytest.raises(TypeError):
+        UnionType([uint64, None])
+    with pytest.raises(TypeError):
+        UnionType([None])

@@ -1,0 +1,100 @@
+"""Per-fork container type tests (reference analog: types package tests +
+ssz_static structural checks)."""
+
+import pytest
+
+from lodestar_tpu.params import MAINNET, MINIMAL
+from lodestar_tpu.types import get_types
+
+
+@pytest.fixture(scope="module")
+def t():
+    return get_types(MINIMAL)
+
+
+def test_state_field_evolution(t):
+    phase0_fields = [n for n, _ in t.phase0.BeaconState.fields]
+    altair_fields = [n for n, _ in t.altair.BeaconState.fields]
+    capella_fields = [n for n, _ in t.capella.BeaconState.fields]
+    assert "previous_epoch_attestations" in phase0_fields
+    assert "previous_epoch_attestations" not in altair_fields
+    assert "previous_epoch_participation" in altair_fields
+    assert altair_fields[-3:] == [
+        "inactivity_scores",
+        "current_sync_committee",
+        "next_sync_committee",
+    ]
+    assert capella_fields[-3:] == [
+        "next_withdrawal_index",
+        "next_withdrawal_validator_index",
+        "historical_summaries",
+    ]
+    # phase0 prefix is preserved in order
+    assert altair_fields[: phase0_fields.index("previous_epoch_attestations")] == phase0_fields[
+        : phase0_fields.index("previous_epoch_attestations")
+    ]
+
+
+def test_default_state_roundtrip_all_forks(t):
+    for fork in ("phase0", "altair", "bellatrix", "capella"):
+        ns = getattr(t, fork)
+        state = ns.BeaconState.default()
+        data = state.serialize()
+        state2 = ns.BeaconState.deserialize(data)
+        assert state2 == state
+        assert state.hash_tree_root() == state2.hash_tree_root()
+
+
+def test_fork_roots_differ(t):
+    r = {
+        fork: getattr(t, fork).BeaconState.default().hash_tree_root()
+        for fork in ("phase0", "altair", "bellatrix", "capella")
+    }
+    assert len(set(r.values())) == 4
+
+
+def test_signed_block_roundtrip(t):
+    block = t.capella.SignedBeaconBlock.default()
+    block.message.slot = 42
+    block.message.body.graffiti = b"lodestar-tpu".ljust(32, b"\x00")
+    block.message.body.attestations = [
+        t.phase0.Attestation(
+            aggregation_bits=[True, False, True],
+            signature=b"\xaa" * 96,
+        )
+    ]
+    data = block.serialize()
+    block2 = t.capella.SignedBeaconBlock.deserialize(data)
+    assert block2 == block
+    assert block2.message.body.attestations[0].aggregation_bits == [True, False, True]
+
+
+def test_validator_fixed_size(t):
+    v = t.phase0.Validator.ssz_type
+    assert v.is_fixed_size()
+    assert v.fixed_size() == 121  # 48+32+8+1+8+8+8+8
+
+
+def test_mainnet_vs_minimal_types_differ():
+    tm = get_types(MAINNET)
+    tmin = get_types(MINIMAL)
+    # sync committee sizes differ -> serialized sizes differ
+    assert len(tm.altair.SyncCommittee.default().serialize()) != len(
+        tmin.altair.SyncCommittee.default().serialize()
+    )
+
+
+def test_execution_payload_capella_withdrawals(t):
+    p = t.capella.ExecutionPayload.default()
+    p.withdrawals = [t.capella.Withdrawal(index=1, validator_index=2, address=b"\x11" * 20, amount=3)]
+    data = p.serialize()
+    p2 = t.capella.ExecutionPayload.deserialize(data)
+    assert p2.withdrawals[0].amount == 3
+
+
+def test_light_client_types(t):
+    upd = t.altair.LightClientUpdate.default()
+    assert len(upd.finality_branch) == 6
+    assert len(upd.next_sync_committee_branch) == 5
+    data = upd.serialize()
+    assert t.altair.LightClientUpdate.deserialize(data) == upd
