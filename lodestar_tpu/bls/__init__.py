@@ -1,0 +1,25 @@
+"""BLS12-381 (CPU oracle tier) — equivalent of @chainsafe/bls + blst.
+
+The TPU tier lives in lodestar_tpu/ops (kernels) + lodestar_tpu/parallel
+(sharded batch verification) and is differentially tested against this
+package.
+"""
+
+from .api import (  # noqa: F401
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_pubkeys,
+    aggregate_signatures,
+    aggregate_verify,
+    fast_aggregate_verify,
+    interop_secret_key,
+    verify,
+    verify_signature_sets,
+)
+from .curve import PointG1, PointG2  # noqa: F401
+from .fields import P as FIELD_MODULUS  # noqa: F401
+from .fields import R as CURVE_ORDER  # noqa: F401
+from .hash_to_curve import DST_G2, hash_to_g2  # noqa: F401

@@ -1,0 +1,269 @@
+"""BLS signature API (ciphersuite BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_).
+
+Equivalent of the `@chainsafe/bls` surface the reference consumes
+(SecretKey/PublicKey/Signature classes + verify/aggregate helpers, used by
+chain/bls/maybeBatch.ts and the worker pool) plus the batch verification
+primitive `verify_signature_sets` mirroring blst's verifyMultipleSignatures:
+random linear combination with one shared final exponentiation.
+
+Pubkeys live in G1 (48B compressed), signatures in G2 (96B compressed) —
+the Eth "minimal-pubkey-size" instantiation. This is the CPU oracle tier; the
+TPU tier (lodestar_tpu/ops + parallel) implements the same batch equation as
+vmapped XLA kernels and is differentially tested against this module.
+
+Cross-validated byte-for-byte against the reference's interop deposit
+signature (beacon-node/test/e2e/interop/genesisState.test.ts).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+from .curve import (
+    PointG1,
+    PointG2,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+)
+from .fields import R as CURVE_ORDER
+from .hash_to_curve import DST_G2, hash_to_g2
+from .pairing import multi_pairing
+
+__all__ = [
+    "SecretKey",
+    "PublicKey",
+    "Signature",
+    "SignatureSet",
+    "aggregate_pubkeys",
+    "aggregate_signatures",
+    "verify",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "verify_signature_sets",
+    "interop_secret_key",
+]
+
+
+class BlsError(ValueError):
+    pass
+
+
+class SecretKey:
+    __slots__ = ("value",)
+
+    def __init__(self, value: int):
+        if not 0 < value < CURVE_ORDER:
+            raise BlsError("secret key out of range")
+        self.value = value
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise BlsError("secret key must be 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    @classmethod
+    def from_keygen(cls, ikm: bytes | None = None) -> "SecretKey":
+        """HKDF-based KeyGen per the BLS signature spec (simplified salt loop)."""
+        ikm = ikm if ikm is not None else secrets.token_bytes(32)
+        salt = b"BLS-SIG-KEYGEN-SALT-"
+        while True:
+            prk = _hkdf_extract(hashlib.sha256(salt).digest(), ikm + b"\x00")
+            okm = _hkdf_expand(prk, (48).to_bytes(2, "big"), 48)
+            sk = int.from_bytes(okm, "big") % CURVE_ORDER
+            if sk != 0:
+                return cls(sk)
+            salt = hashlib.sha256(salt).digest()
+
+    def to_bytes(self) -> bytes:
+        return self.value.to_bytes(32, "big")
+
+    def to_public_key(self) -> "PublicKey":
+        return PublicKey(PointG1.generator() * self.value)
+
+    def sign(self, message: bytes, dst: bytes = DST_G2) -> "Signature":
+        return Signature(hash_to_g2(message, dst) * self.value)
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    import hmac
+
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    import hmac
+
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+class PublicKey:
+    __slots__ = ("point",)
+
+    def __init__(self, point: PointG1):
+        self.point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "PublicKey":
+        point = g1_from_bytes(data)
+        if validate:
+            # KeyValidate: not infinity + subgroup membership
+            if point.is_infinity():
+                raise BlsError("pubkey is point at infinity")
+            if not point.is_in_subgroup():
+                raise BlsError("pubkey not in G1 subgroup")
+        return cls(point)
+
+    def to_bytes(self) -> bytes:
+        return g1_to_bytes(self.point)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, PublicKey) and self.point == other.point
+
+
+class Signature:
+    __slots__ = ("point",)
+
+    def __init__(self, point: PointG2):
+        self.point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes, validate: bool = True) -> "Signature":
+        point = g2_from_bytes(data)
+        if validate and not point.is_infinity() and not point.is_in_subgroup():
+            raise BlsError("signature not in G2 subgroup")
+        return cls(point)
+
+    def to_bytes(self) -> bytes:
+        return g2_to_bytes(self.point)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Signature) and self.point == other.point
+
+
+def aggregate_pubkeys(pubkeys: list[PublicKey]) -> PublicKey:
+    """G1 sum (reference: getAggregatedPubkey on the main thread,
+    chain/bls/utils.ts:5 — jacobian aggregation)."""
+    if not pubkeys:
+        raise BlsError("cannot aggregate empty pubkey list")
+    acc = PointG1.zero()
+    for pk in pubkeys:
+        acc = acc + pk.point
+    return PublicKey(acc)
+
+
+def aggregate_signatures(signatures: list[Signature]) -> Signature:
+    if not signatures:
+        raise BlsError("cannot aggregate empty signature list")
+    acc = PointG2.zero()
+    for sig in signatures:
+        acc = acc + sig.point
+    return Signature(acc)
+
+
+_NEG_G1 = -PointG1.generator()
+
+
+def _pairing_check(pairs: list[tuple[PointG1, PointG2]]) -> bool:
+    return multi_pairing(pairs).is_one()
+
+
+def verify(
+    pubkey: PublicKey, message: bytes, signature: Signature, dst: bytes = DST_G2
+) -> bool:
+    """CoreVerify: e(pk, H(m)) == e(g1, sig), i.e.
+    e(pk, H(m)) · e(−g1, sig) == 1. Infinity pubkey/signature → False
+    (eth2 semantics)."""
+    if pubkey.point.is_infinity() or signature.point.is_infinity():
+        return False
+    h = hash_to_g2(message, dst)
+    return _pairing_check([(pubkey.point, h), (_NEG_G1, signature.point)])
+
+
+def aggregate_verify(
+    pubkeys: list[PublicKey],
+    messages: list[bytes],
+    signature: Signature,
+    dst: bytes = DST_G2,
+) -> bool:
+    if not pubkeys or len(pubkeys) != len(messages):
+        return False
+    if any(pk.point.is_infinity() for pk in pubkeys) or signature.point.is_infinity():
+        return False
+    pairs: list[tuple[PointG1, PointG2]] = [
+        (pk.point, hash_to_g2(msg, dst)) for pk, msg in zip(pubkeys, messages)
+    ]
+    pairs.append((_NEG_G1, signature.point))
+    return _pairing_check(pairs)
+
+
+def fast_aggregate_verify(
+    pubkeys: list[PublicKey], message: bytes, signature: Signature, dst: bytes = DST_G2
+) -> bool:
+    """All pubkeys sign the same message (sync-committee aggregate path,
+    512 pubkeys: baseline config #4)."""
+    if not pubkeys:
+        return False
+    return verify(aggregate_pubkeys(pubkeys), message, signature, dst)
+
+
+@dataclass
+class SignatureSet:
+    """One verification work item: pubkey is pre-aggregated by the caller
+    (reference ISignatureSet, chain/bls/interface.ts:20; aggregation happens
+    main-thread per bls/utils.ts)."""
+
+    pubkey: PublicKey
+    message: bytes  # 32-byte signing root
+    signature: bytes  # 96-byte compressed G2
+
+
+def verify_signature_sets(sets: list[SignatureSet]) -> bool:
+    """Batch verification with random linear combination (blst
+    verifyMultipleSignatures equivalent; reference calls it for ≥2 sets —
+    maybeBatch.ts:16-27):
+
+        Π e(r_i·pk_i, H(m_i)) · e(−g1, Σ r_i·sig_i) == 1
+
+    with independent random 64-bit nonzero r_i. Putting r_i on the pubkey
+    (G1) side keeps the extra scalar mul in the cheaper group.
+    """
+    if not sets:
+        return False
+    try:
+        pairs: list[tuple[PointG1, PointG2]] = []
+        sig_acc = PointG2.zero()
+        for s in sets:
+            if s.pubkey.point.is_infinity():
+                return False
+            sig = Signature.from_bytes(s.signature).point
+            if sig.is_infinity():
+                return False
+            r = 0
+            while r == 0:
+                r = secrets.randbits(64)
+            pairs.append((s.pubkey.point * r, hash_to_g2(s.message)))
+            sig_acc = sig_acc + sig * r
+        pairs.append((_NEG_G1, sig_acc))
+        return _pairing_check(pairs)
+    except (BlsError, ValueError):
+        return False
+
+
+def interop_secret_key(index: int) -> SecretKey:
+    """Deterministic interop secret key i (reference:
+    state-transition/src/util/interop.ts interopSecretKey):
+    sk = int_le(sha256(uint256_le(i))) mod r."""
+    h = hashlib.sha256(index.to_bytes(32, "little")).digest()
+    return SecretKey(int.from_bytes(h, "little") % CURVE_ORDER)

@@ -1,0 +1,329 @@
+"""BLS12-381 curve groups G1 (over Fq) and G2 (over Fq2).
+
+Jacobian-coordinate arithmetic, scalar multiplication, subgroup membership,
+and the ZCash point-serialization format (compressed/uncompressed with
+C/I/S flag bits) used by Eth consensus. Oracle tier — clarity over speed.
+
+E1: y² = x³ + 4        over Fq
+E2: y² = x³ + 4(1+u)   over Fq2   (M-twist with ξ = 1+u)
+"""
+
+from __future__ import annotations
+
+from typing import Generic, TypeVar, Union
+
+from .fields import P, R, X_PARAM, Fq, Fq2, Fq6, Fq12, XI
+
+F = TypeVar("F", Fq, Fq2)
+
+B1 = Fq(4)
+B2 = Fq2.from_ints(4, 4)
+
+# Standard generators (public BLS12-381 parameters)
+G1_X = Fq(
+    0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+)
+G1_Y = Fq(
+    0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+)
+G2_X = Fq2(
+    Fq(0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8),
+    Fq(0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E),
+)
+G2_Y = Fq2(
+    Fq(0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801),
+    Fq(0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE),
+)
+
+
+class Point(Generic[F]):
+    """Jacobian point (X, Y, Z); Z=0 is the point at infinity."""
+
+    __slots__ = ("x", "y", "z", "b")
+
+    def __init__(self, x: F, y: F, z: F, b: F):
+        self.x, self.y, self.z, self.b = x, y, z, b
+
+    # -- constructors --
+    @classmethod
+    def from_affine(cls, x: F, y: F, b: F) -> "Point[F]":
+        one = type(x).one()
+        return cls(x, y, one, b)
+
+    @classmethod
+    def infinity(cls, field, b) -> "Point":
+        return cls(field.one(), field.one(), field.zero(), b)
+
+    def is_infinity(self) -> bool:
+        return self.z.is_zero()
+
+    def to_affine(self) -> tuple[F, F] | None:
+        if self.is_infinity():
+            return None
+        zinv = self.z.inverse()
+        zinv2 = zinv * zinv
+        return (self.x * zinv2, self.y * zinv2 * zinv)
+
+    def is_on_curve(self) -> bool:
+        if self.is_infinity():
+            return True
+        aff = self.to_affine()
+        assert aff is not None
+        x, y = aff
+        return y * y == x * x * x + self.b
+
+    # -- group law (jacobian, a = 0) --
+    def double(self) -> "Point[F]":
+        if self.is_infinity():
+            return self
+        X1, Y1, Z1 = self.x, self.y, self.z
+        A = X1 * X1
+        B = Y1 * Y1
+        C = B * B
+        t = X1 + B
+        D = t * t - A - C
+        D = D + D
+        E = A + A + A
+        Fv = E * E
+        X3 = Fv - (D + D)
+        eight_c = C + C
+        eight_c = eight_c + eight_c
+        eight_c = eight_c + eight_c
+        Y3 = E * (D - X3) - eight_c
+        Z3 = (Y1 + Y1) * Z1
+        return type(self)(X3, Y3, Z3, self.b)
+
+    def __add__(self, other: "Point[F]") -> "Point[F]":
+        if self.is_infinity():
+            return other
+        if other.is_infinity():
+            return self
+        X1, Y1, Z1 = self.x, self.y, self.z
+        X2, Y2, Z2 = other.x, other.y, other.z
+        Z1Z1 = Z1 * Z1
+        Z2Z2 = Z2 * Z2
+        U1 = X1 * Z2Z2
+        U2 = X2 * Z1Z1
+        S1 = Y1 * Z2 * Z2Z2
+        S2 = Y2 * Z1 * Z1Z1
+        if U1 == U2:
+            if S1 == S2:
+                return self.double()
+            return type(self).infinity(type(X1), self.b)
+        H = U2 - U1
+        t = H + H
+        I = t * t
+        J = H * I
+        r = S2 - S1
+        r = r + r
+        V = U1 * I
+        X3 = r * r - J - (V + V)
+        S1J = S1 * J
+        Y3 = r * (V - X3) - (S1J + S1J)
+        Z3 = ((Z1 + Z2) * (Z1 + Z2) - Z1Z1 - Z2Z2) * H
+        return type(self)(X3, Y3, Z3, self.b)
+
+    def __neg__(self) -> "Point[F]":
+        return type(self)(self.x, -self.y, self.z, self.b)
+
+    def __sub__(self, other: "Point[F]") -> "Point[F]":
+        return self + (-other)
+
+    def __mul__(self, scalar: int) -> "Point[F]":
+        """Scalar multiplication (double-and-add; not constant-time — the
+        oracle only handles public data except in tests)."""
+        k = int(scalar)
+        if k < 0:
+            return (-self) * (-k)
+        result = type(self).infinity(type(self.x), self.b)
+        addend = self
+        while k:
+            if k & 1:
+                result = result + addend
+            addend = addend.double()
+            k >>= 1
+        return result
+
+    __rmul__ = __mul__
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Point):
+            return NotImplemented
+        # (X1/Z1², Y1/Z1³) == (X2/Z2², Y2/Z2³) cross-multiplied
+        if self.is_infinity() or other.is_infinity():
+            return self.is_infinity() and other.is_infinity()
+        Z1Z1 = self.z * self.z
+        Z2Z2 = other.z * other.z
+        return (
+            self.x * Z2Z2 == other.x * Z1Z1
+            and self.y * Z2Z2 * other.z == other.y * Z1Z1 * self.z
+        )
+
+    def __repr__(self) -> str:
+        aff = self.to_affine()
+        return f"{type(self).__name__}({aff!r})"
+
+
+class PointG1(Point[Fq]):
+    __slots__ = ()
+
+    def __init__(self, x: Fq, y: Fq, z: Fq, b: Fq | None = None):
+        super().__init__(x, y, z, b if b is not None else B1)
+
+    @staticmethod
+    def generator() -> "PointG1":
+        return PointG1(G1_X, G1_Y, Fq.one())
+
+    @staticmethod
+    def zero() -> "PointG1":
+        return PointG1(Fq.one(), Fq.one(), Fq.zero())
+
+    def is_in_subgroup(self) -> bool:
+        return (self * R).is_infinity()
+
+
+class PointG2(Point[Fq2]):
+    __slots__ = ()
+
+    def __init__(self, x: Fq2, y: Fq2, z: Fq2, b: Fq2 | None = None):
+        super().__init__(x, y, z, b if b is not None else B2)
+
+    @staticmethod
+    def generator() -> "PointG2":
+        return PointG2(G2_X, G2_Y, Fq2.one())
+
+    @staticmethod
+    def zero() -> "PointG2":
+        return PointG2(Fq2.one(), Fq2.one(), Fq2.zero())
+
+    def is_in_subgroup(self) -> bool:
+        return (self * R).is_infinity()
+
+    def psi(self) -> "PointG2":
+        """Untwist-Frobenius-twist endomorphism ψ (used for fast cofactor
+        clearing, Budroni–Pintore)."""
+        aff = self.to_affine()
+        if aff is None:
+            return self
+        x, y = aff
+        return PointG2(x.conjugate() * _PSI_CX, y.conjugate() * _PSI_CY, Fq2.one())
+
+
+# ψ coefficients: untwist (x/w², y/w³), frobenius, retwist (·w², ·w³):
+# ψ(x, y) = (conj(x)·w^(2p)/w² , conj(y)·w^(3p)/w³) with w^(p−1) expressible
+# via ξ: w^(p−1) = ξ^((p−1)/6). So cx = ξ^((p−1)/3)⁻¹... computed directly:
+# cx = 1/ξ^((p−1)/3), cy = 1/ξ^((p−1)/2).
+_PSI_CX = XI.pow((P - 1) // 3).inverse()
+_PSI_CY = XI.pow((P - 1) // 2).inverse()
+
+
+_HALF_P = (P - 1) // 2
+
+
+def _fq_lex_larger(y: Fq) -> bool:
+    return y.n > _HALF_P
+
+
+def _fq2_lex_larger(y: Fq2) -> bool:
+    """ZCash convention: compare (c1, c0) lexicographically."""
+    if y.c1.n != 0:
+        return y.c1.n > _HALF_P
+    return y.c0.n > _HALF_P
+
+
+# --- ZCash serialization (the Eth consensus wire format) ---
+
+_C_FLAG = 0x80  # compressed
+_I_FLAG = 0x40  # infinity
+_S_FLAG = 0x20  # sign (lexicographically larger y)
+
+
+def g1_to_bytes(point: PointG1, compressed: bool = True) -> bytes:
+    if not compressed:
+        raise NotImplementedError("only compressed G1 serialization")
+    if point.is_infinity():
+        return bytes([_C_FLAG | _I_FLAG]) + b"\x00" * 47
+    aff = point.to_affine()
+    assert aff is not None
+    x, y = aff
+    data = bytearray(x.n.to_bytes(48, "big"))
+    data[0] |= _C_FLAG
+    if _fq_lex_larger(y):
+        data[0] |= _S_FLAG
+    return bytes(data)
+
+
+def g1_from_bytes(data: bytes) -> PointG1:
+    if len(data) != 48:
+        raise ValueError(f"G1 compressed point must be 48 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("G1: uncompressed deserialization not supported")
+    if flags & _I_FLAG:
+        if flags & _S_FLAG or any(data[1:]) or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("G1: malformed infinity encoding")
+        return PointG1.zero()
+    xn = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if xn >= P:
+        raise ValueError("G1: x not in field")
+    x = Fq(xn)
+    y2 = x * x * x + B1
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("G1: x not on curve")
+    if _fq_lex_larger(y) != bool(flags & _S_FLAG):
+        y = -y
+    return PointG1(x, y, Fq.one())
+
+
+def g2_to_bytes(point: PointG2, compressed: bool = True) -> bytes:
+    if not compressed:
+        raise NotImplementedError("only compressed G2 serialization")
+    if point.is_infinity():
+        return bytes([_C_FLAG | _I_FLAG]) + b"\x00" * 95
+    aff = point.to_affine()
+    assert aff is not None
+    x, y = aff
+    data = bytearray(x.c1.n.to_bytes(48, "big") + x.c0.n.to_bytes(48, "big"))
+    data[0] |= _C_FLAG
+    if _fq2_lex_larger(y):
+        data[0] |= _S_FLAG
+    return bytes(data)
+
+
+def g2_from_bytes(data: bytes) -> PointG2:
+    if len(data) != 96:
+        raise ValueError(f"G2 compressed point must be 96 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & _C_FLAG:
+        raise ValueError("G2: uncompressed deserialization not supported")
+    if flags & _I_FLAG:
+        if flags & _S_FLAG or any(data[1:]) or data[0] != (_C_FLAG | _I_FLAG):
+            raise ValueError("G2: malformed infinity encoding")
+        return PointG2.zero()
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2: x not in field")
+    x = Fq2.from_ints(x0, x1)
+    y2 = x * x * x + B2
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("G2: x not on curve")
+    if _fq2_lex_larger(y) != bool(flags & _S_FLAG):
+        y = -y
+    return PointG2(x, y, Fq2.one())
+
+
+def clear_cofactor_g2(point: PointG2) -> PointG2:
+    """Map an E2(Fq2) point into the order-r subgroup G2.
+
+    Budroni–Pintore endomorphism method (as referenced by RFC 9380 for the
+    BLS12381G2 suites): h_eff·P = [x²−x−1]P + [x−1]ψ(P) + ψ²([2]P)
+    with x the (negative) BLS parameter.
+    """
+    x = X_PARAM
+    t1 = point * (x * x - x - 1)
+    t2 = point.psi() * (x - 1)
+    t3 = point.double().psi().psi()
+    return t1 + t2 + t3

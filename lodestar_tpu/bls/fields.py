@@ -1,0 +1,413 @@
+"""BLS12-381 field towers over Python big ints (the CPU oracle tier).
+
+Equivalent role of the supranational `blst` C library behind
+`@chainsafe/blst` in the reference (SURVEY.md §2.3): this module is the
+*correctness oracle* — written for clarity and auditability, not speed. The
+TPU tier (lodestar_tpu/ops) is differentially tested against it.
+
+Tower (standard for BLS12-381):
+    Fq2  = Fq[u]  / (u² + 1)
+    Fq6  = Fq2[v] / (v³ − ξ),  ξ = 1 + u
+    Fq12 = Fq6[w] / (w² − v)         (so w⁶ = ξ)
+
+All constants below are the standard public BLS12-381 parameters; nothing is
+copied from the reference repo (which contains no field arithmetic — it calls
+blst via FFI).
+"""
+
+from __future__ import annotations
+
+# Base field modulus
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# Subgroup order
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+# BLS parameter x (negative): p and r are polynomials in x.
+X_PARAM = -0xD201000000010000
+
+
+class Fq:
+    """Prime field element (immutable)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self, n: int):
+        self.n = n % P
+
+    def __add__(self, other: "Fq") -> "Fq":
+        return Fq(self.n + other.n)
+
+    def __sub__(self, other: "Fq") -> "Fq":
+        return Fq(self.n - other.n)
+
+    def __mul__(self, other: "Fq") -> "Fq":
+        return Fq(self.n * other.n)
+
+    def __neg__(self) -> "Fq":
+        return Fq(-self.n)
+
+    def square(self) -> "Fq":
+        return Fq(self.n * self.n)
+
+    def inverse(self) -> "Fq":
+        if self.n == 0:
+            raise ZeroDivisionError("Fq inverse of 0")
+        return Fq(pow(self.n, P - 2, P))
+
+    def pow(self, e: int) -> "Fq":
+        return Fq(pow(self.n, e, P))
+
+    def is_zero(self) -> bool:
+        return self.n == 0
+
+    def is_square(self) -> bool:
+        return self.n == 0 or pow(self.n, (P - 1) // 2, P) == 1
+
+    def sqrt(self) -> "Fq | None":
+        """Square root for p ≡ 3 (mod 4); None if not a QR."""
+        if self.n == 0:
+            return Fq(0)
+        cand = pow(self.n, (P + 1) // 4, P)
+        if cand * cand % P == self.n:
+            return Fq(cand)
+        return None
+
+    def sgn0(self) -> int:
+        return self.n & 1
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Fq) and self.n == other.n
+
+    def __hash__(self) -> int:
+        return hash(("Fq", self.n))
+
+    def __repr__(self) -> str:
+        return f"Fq(0x{self.n:x})"
+
+    @staticmethod
+    def zero() -> "Fq":
+        return Fq(0)
+
+    @staticmethod
+    def one() -> "Fq":
+        return Fq(1)
+
+
+class Fq2:
+    """Fq[u]/(u²+1): c0 + c1·u."""
+
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Fq, c1: Fq):
+        self.c0 = c0
+        self.c1 = c1
+
+    @staticmethod
+    def from_ints(a: int, b: int) -> "Fq2":
+        return Fq2(Fq(a), Fq(b))
+
+    def __add__(self, o: "Fq2") -> "Fq2":
+        return Fq2(self.c0 + o.c0, self.c1 + o.c1)
+
+    def __sub__(self, o: "Fq2") -> "Fq2":
+        return Fq2(self.c0 - o.c0, self.c1 - o.c1)
+
+    def __mul__(self, o: "Fq2") -> "Fq2":
+        # (a0 + a1 u)(b0 + b1 u) = a0b0 − a1b1 + (a0b1 + a1b0) u
+        t0 = self.c0 * o.c0
+        t1 = self.c1 * o.c1
+        t2 = (self.c0 + self.c1) * (o.c0 + o.c1)
+        return Fq2(t0 - t1, t2 - t0 - t1)
+
+    def mul_scalar(self, k: Fq) -> "Fq2":
+        return Fq2(self.c0 * k, self.c1 * k)
+
+    def __neg__(self) -> "Fq2":
+        return Fq2(-self.c0, -self.c1)
+
+    def square(self) -> "Fq2":
+        # (a + bu)² = (a+b)(a−b) + 2ab·u
+        a, b = self.c0, self.c1
+        return Fq2((a + b) * (a - b), Fq(2 * a.n * b.n))
+
+    def conjugate(self) -> "Fq2":
+        return Fq2(self.c0, -self.c1)
+
+    def norm(self) -> Fq:
+        return self.c0.square() + self.c1.square()
+
+    def inverse(self) -> "Fq2":
+        inv_norm = self.norm().inverse()
+        return Fq2(self.c0 * inv_norm, -(self.c1 * inv_norm))
+
+    def pow(self, e: int) -> "Fq2":
+        if e < 0:
+            return self.inverse().pow(-e)
+        result = Fq2.one()
+        base = self
+        while e > 0:
+            if e & 1:
+                result = result * base
+            base = base.square()
+            e >>= 1
+        return result
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero()
+
+    def is_square(self) -> bool:
+        # a is a square in Fq2 iff norm(a) is a square in Fq
+        return self.norm().is_square()
+
+    def sqrt(self) -> "Fq2 | None":
+        """Square root in Fq2 (q = p² ≡ 9 mod 16): candidate a^((q+7)/16)
+        corrected by a root of unity from {1, i, ω, iω} with ω² = i."""
+        if self.is_zero():
+            return Fq2.zero()
+        cand = self.pow((P * P + 7) // 16)
+        for root in _SQRT_CORRECTIONS:
+            s = cand * root
+            if s * s == self:
+                return s
+        return None
+
+    def sgn0(self) -> int:
+        # RFC 9380 sgn0 for m=2
+        sign_0 = self.c0.n & 1
+        zero_0 = self.c0.n == 0
+        return sign_0 | (int(zero_0) & (self.c1.n & 1))
+
+    def frobenius(self) -> "Fq2":
+        # x^p = conjugate (u^p = -u since p ≡ 3 mod 4)
+        return self.conjugate()
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Fq2) and self.c0 == other.c0 and self.c1 == other.c1
+
+    def __hash__(self) -> int:
+        return hash(("Fq2", self.c0.n, self.c1.n))
+
+    def __repr__(self) -> str:
+        return f"Fq2(0x{self.c0.n:x}, 0x{self.c1.n:x})"
+
+    @staticmethod
+    def zero() -> "Fq2":
+        return Fq2(Fq(0), Fq(0))
+
+    @staticmethod
+    def one() -> "Fq2":
+        return Fq2(Fq(1), Fq(0))
+
+
+# ξ = 1 + u: the Fq6/Fq12 non-residue
+XI = Fq2.from_ints(1, 1)
+
+# sqrt corrections: {1, i, ω, iω} with i = sqrt(-1) = u, ω = sqrt(i)
+_I = Fq2.from_ints(0, 1)
+
+
+def _compute_sqrt_i() -> Fq2:
+    # (a + bu)² = u  =>  a² − b² = 0, 2ab = 1. With b = a: 2a² = 1;
+    # with b = −a: −2a² = 1. Exactly one of ±1/2 is a QR mod p.
+    half = Fq(pow(2, P - 2, P))
+    a = half.sqrt()
+    if a is not None:
+        return Fq2(a, a)
+    a = (-half).sqrt()
+    assert a is not None
+    return Fq2(a, -a)
+
+
+_OMEGA = _compute_sqrt_i()
+_SQRT_CORRECTIONS = [Fq2.one(), _I, _OMEGA, _I * _OMEGA]
+
+
+class Fq6:
+    """Fq2[v]/(v³ − ξ): c0 + c1·v + c2·v²."""
+
+    __slots__ = ("c0", "c1", "c2")
+
+    def __init__(self, c0: Fq2, c1: Fq2, c2: Fq2):
+        self.c0, self.c1, self.c2 = c0, c1, c2
+
+    def __add__(self, o: "Fq6") -> "Fq6":
+        return Fq6(self.c0 + o.c0, self.c1 + o.c1, self.c2 + o.c2)
+
+    def __sub__(self, o: "Fq6") -> "Fq6":
+        return Fq6(self.c0 - o.c0, self.c1 - o.c1, self.c2 - o.c2)
+
+    def __neg__(self) -> "Fq6":
+        return Fq6(-self.c0, -self.c1, -self.c2)
+
+    def __mul__(self, o: "Fq6") -> "Fq6":
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        b0, b1, b2 = o.c0, o.c1, o.c2
+        t0 = a0 * b0
+        t1 = a1 * b1
+        t2 = a2 * b2
+        # c0 = t0 + ξ((a1+a2)(b1+b2) − t1 − t2)
+        c0 = t0 + XI * ((a1 + a2) * (b1 + b2) - t1 - t2)
+        # c1 = (a0+a1)(b0+b1) − t0 − t1 + ξ t2
+        c1 = (a0 + a1) * (b0 + b1) - t0 - t1 + XI * t2
+        # c2 = (a0+a2)(b0+b2) − t0 − t2 + t1
+        c2 = (a0 + a2) * (b0 + b2) - t0 - t2 + t1
+        return Fq6(c0, c1, c2)
+
+    def mul_by_fq2(self, k: Fq2) -> "Fq6":
+        return Fq6(self.c0 * k, self.c1 * k, self.c2 * k)
+
+    def mul_by_v(self) -> "Fq6":
+        # v·(c0 + c1 v + c2 v²) = ξ c2 + c0 v + c1 v²
+        return Fq6(XI * self.c2, self.c0, self.c1)
+
+    def square(self) -> "Fq6":
+        return self * self
+
+    def inverse(self) -> "Fq6":
+        a, b, c = self.c0, self.c1, self.c2
+        # Standard tower inversion
+        t0 = a.square() - XI * (b * c)
+        t1 = XI * c.square() - (a * b)
+        t2 = b.square() - (a * c)
+        denom = a * t0 + XI * (c * t1 + b * t2)
+        inv = denom.inverse()
+        return Fq6(t0 * inv, t1 * inv, t2 * inv)
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero() and self.c2.is_zero()
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Fq6)
+            and self.c0 == other.c0
+            and self.c1 == other.c1
+            and self.c2 == other.c2
+        )
+
+    def __repr__(self) -> str:
+        return f"Fq6({self.c0!r}, {self.c1!r}, {self.c2!r})"
+
+    @staticmethod
+    def zero() -> "Fq6":
+        return Fq6(Fq2.zero(), Fq2.zero(), Fq2.zero())
+
+    @staticmethod
+    def one() -> "Fq6":
+        return Fq6(Fq2.one(), Fq2.zero(), Fq2.zero())
+
+
+class Fq12:
+    """Fq6[w]/(w² − v): c0 + c1·w."""
+
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0: Fq6, c1: Fq6):
+        self.c0, self.c1 = c0, c1
+
+    def __add__(self, o: "Fq12") -> "Fq12":
+        return Fq12(self.c0 + o.c0, self.c1 + o.c1)
+
+    def __sub__(self, o: "Fq12") -> "Fq12":
+        return Fq12(self.c0 - o.c0, self.c1 - o.c1)
+
+    def __neg__(self) -> "Fq12":
+        return Fq12(-self.c0, -self.c1)
+
+    def __mul__(self, o: "Fq12") -> "Fq12":
+        a0, a1 = self.c0, self.c1
+        b0, b1 = o.c0, o.c1
+        t0 = a0 * b0
+        t1 = a1 * b1
+        # w² = v
+        c0 = t0 + t1.mul_by_v()
+        c1 = (a0 + a1) * (b0 + b1) - t0 - t1
+        return Fq12(c0, c1)
+
+    def square(self) -> "Fq12":
+        return self * self
+
+    def conjugate(self) -> "Fq12":
+        """x^(p⁶): negates the w-component (the Fq12/Fq6 conjugation)."""
+        return Fq12(self.c0, -self.c1)
+
+    def inverse(self) -> "Fq12":
+        # (c0 + c1 w)⁻¹ = (c0 − c1 w)/(c0² − v c1²)
+        denom = self.c0.square() - self.c1.square().mul_by_v()
+        inv = denom.inverse()
+        return Fq12(self.c0 * inv, -(self.c1 * inv))
+
+    def pow(self, e: int) -> "Fq12":
+        if e < 0:
+            return self.inverse().pow(-e)
+        result = Fq12.one()
+        base = self
+        while e > 0:
+            if e & 1:
+                result = result * base
+            base = base.square()
+            e >>= 1
+        return result
+
+    def is_zero(self) -> bool:
+        return self.c0.is_zero() and self.c1.is_zero()
+
+    def is_one(self) -> bool:
+        return self == Fq12.one()
+
+    # --- flattened view for Frobenius: Fq12 = Fq2[w]/(w⁶ − ξ) ---
+    def to_w_coeffs(self) -> list[Fq2]:
+        """Coefficients [d0..d5] with self = Σ d_i w^i (d_i ∈ Fq2).
+
+        Tower→flat: c0 = a0 + a1 v + a2 v² = a0 + a1 w² + a2 w⁴;
+        c1 w = b0 w + b1 w³ + b2 w⁵.
+        """
+        a, b = self.c0, self.c1
+        return [a.c0, b.c0, a.c1, b.c1, a.c2, b.c2]
+
+    @staticmethod
+    def from_w_coeffs(d: list[Fq2]) -> "Fq12":
+        return Fq12(Fq6(d[0], d[2], d[4]), Fq6(d[1], d[3], d[5]))
+
+    def frobenius(self, power: int = 1) -> "Fq12":
+        """x^(p^power) via the flattened representation:
+        φ^k(Σ d_i w^i) = Σ conj^k(d_i) · γ_i^(k) · w^i,
+        γ_i^(k) = ξ^(i(p^k − 1)/6)."""
+        if power not in (1, 2, 3):
+            raise ValueError(f"frobenius power {power} not precomputed")
+        coeffs = self.to_w_coeffs()
+        gammas = _FROB_GAMMA[power]
+        out = []
+        for i, d in enumerate(coeffs):
+            if power % 2 == 1:
+                d = d.conjugate()
+            out.append(d * gammas[i])
+        return Fq12.from_w_coeffs(out)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Fq12) and self.c0 == other.c0 and self.c1 == other.c1
+
+    def __repr__(self) -> str:
+        return f"Fq12({self.c0!r}, {self.c1!r})"
+
+    @staticmethod
+    def zero() -> "Fq12":
+        return Fq12(Fq6.zero(), Fq6.zero())
+
+    @staticmethod
+    def one() -> "Fq12":
+        return Fq12(Fq6.one(), Fq6.zero())
+
+
+def _compute_frob_gammas() -> dict[int, list[Fq2]]:
+    """γ_i^(k) = ξ^(i(p^k−1)/6) for k in 1..3 (all we need), i in 0..5."""
+    out: dict[int, list[Fq2]] = {}
+    for k in (1, 2, 3):
+        exp = (P**k - 1) // 6
+        base = XI.pow(exp)
+        gammas = [Fq2.one()]
+        for _ in range(5):
+            gammas.append(gammas[-1] * base)
+        out[k] = gammas
+    return out
+
+
+_FROB_GAMMA = _compute_frob_gammas()

@@ -1,0 +1,291 @@
+"""Hash-to-curve for G2: RFC 9380 suite BLS12381G2_XMD:SHA-256_SSWU_RO_.
+
+Pipeline: expand_message_xmd(SHA-256) → hash_to_field(Fq2, count=2) →
+simplified SWU onto the 3-isogenous curve E2' → 3-isogeny to E2 →
+clear_cofactor (Budroni–Pintore endomorphism method) — exactly the RFC
+construction for the Eth BLS signature ciphersuite
+(BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_).
+
+The degree-3 isogeny E2' → E2 is *derived at import time* via Vélu's
+formulas (kernel found by factoring the 3-division polynomial of E2' over
+Fq2) rather than hard-coding the RFC Appendix E.3 constants; the derivation
+asserts that the codomain lands exactly on E2 (y² = x³ + 4(1+u)). Velu's
+formulas give the normalized isogeny, which is the one the RFC specifies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .curve import B2, PointG2, clear_cofactor_g2
+from .fields import P, Fq, Fq2
+
+# --- RFC 9380 §8.8.2 curve parameters for E2': y² = x³ + A'x + B' ---
+A_PRIME = Fq2.from_ints(0, 240)
+B_PRIME = Fq2.from_ints(1012, 1012)
+Z_SSWU = Fq2.from_ints(P - 2, P - 1)  # Z = -(2 + u)
+
+DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+_SHA256_BLOCK_SIZE = 64
+_SHA256_OUT_SIZE = 32
+_L = 64  # bytes per field element draw (ceil((381 + 128)/8))
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 expand_message_xmd with SHA-256."""
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    ell = (len_in_bytes + _SHA256_OUT_SIZE - 1) // _SHA256_OUT_SIZE
+    if ell > 255:
+        raise ValueError("expand_message_xmd: output too long")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * _SHA256_BLOCK_SIZE
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        tmp = bytes(x ^ y for x, y in zip(b0, b[-1]))
+        b.append(hashlib.sha256(tmp + bytes([i]) + dst_prime).digest())
+    return b"".join(b)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, count: int, dst: bytes = DST_G2) -> list[Fq2]:
+    """RFC 9380 §5.2 hash_to_field with m=2 (Fq2), L=64."""
+    len_in_bytes = count * 2 * _L
+    uniform = expand_message_xmd(msg, dst, len_in_bytes)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(2):
+            offset = _L * (j + i * 2)
+            coords.append(Fq(int.from_bytes(uniform[offset : offset + _L], "big")))
+        out.append(Fq2(coords[0], coords[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Degree-3 isogeny E2' → E2, derived via Vélu's formulas at import time.
+# ---------------------------------------------------------------------------
+
+
+def _poly_mulmod(a: list[Fq2], b: list[Fq2], mod: list[Fq2]) -> list[Fq2]:
+    """(a*b) mod `mod` — dense poly arithmetic over Fq2, low-degree only."""
+    res = [Fq2.zero()] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai.is_zero():
+            continue
+        for j, bj in enumerate(b):
+            res[i + j] = res[i + j] + ai * bj
+    return _poly_mod(res, mod)
+
+
+def _poly_mod(a: list[Fq2], mod: list[Fq2]) -> list[Fq2]:
+    a = list(a)
+    dm = len(mod) - 1
+    lead_inv = mod[-1].inverse()
+    while len(a) - 1 >= dm:
+        coef = a[-1] * lead_inv
+        shift = len(a) - 1 - dm
+        for i in range(len(mod)):
+            a[shift + i] = a[shift + i] - coef * mod[i]
+        while len(a) > 1 and a[-1].is_zero():
+            a.pop()
+        if len(a) == 1 and a[0].is_zero():
+            break
+    return a
+
+
+def _poly_gcd(a: list[Fq2], b: list[Fq2]) -> list[Fq2]:
+    while len(b) > 1 or not b[0].is_zero():
+        a, b = b, _poly_mod(a, b)
+        if len(b) == 1 and b[0].is_zero():
+            break
+    # normalize monic
+    inv = a[-1].inverse()
+    return [c * inv for c in a]
+
+
+def _poly_powmod(base: list[Fq2], e: int, mod: list[Fq2]) -> list[Fq2]:
+    result = [Fq2.one()]
+    b = _poly_mod(base, mod)
+    while e > 0:
+        if e & 1:
+            result = _poly_mulmod(result, b, mod)
+        b = _poly_mulmod(b, b, mod)
+        e >>= 1
+    return result
+
+
+def _find_quartic_roots(poly: list[Fq2]) -> list[Fq2]:
+    """Roots in Fq2 of a quartic (equal-degree splitting, deterministic
+    sweep of shift elements)."""
+    q = P * P
+    # g = gcd(x^q - x, poly): product of linear factors over Fq2
+    xq = _poly_powmod([Fq2.zero(), Fq2.one()], q, poly)
+    xq_minus_x = list(xq)
+    while len(xq_minus_x) < 2:
+        xq_minus_x.append(Fq2.zero())
+    xq_minus_x[1] = xq_minus_x[1] - Fq2.one()
+    g = _poly_gcd(poly, xq_minus_x)
+
+    roots: list[Fq2] = []
+
+    def split(h: list[Fq2]) -> None:
+        deg = len(h) - 1
+        if deg == 0:
+            return
+        if deg == 1:
+            # monic x + c -> root -c
+            roots.append(-h[0])
+            return
+        # try shifts deterministically: s(x) = (x + delta)^((q-1)/2) - 1
+        for delta_ints in ((0, 0), (1, 0), (0, 1), (1, 1), (2, 0), (0, 2), (2, 1), (3, 5)):
+            delta = Fq2.from_ints(*delta_ints)
+            s = _poly_powmod([delta, Fq2.one()], (q - 1) // 2, h)
+            s = list(s)
+            s[0] = s[0] - Fq2.one()
+            while len(s) > 1 and s[-1].is_zero():
+                s.pop()
+            if len(s) == 1 and s[0].is_zero():
+                continue
+            f1 = _poly_gcd(h, s)
+            if 0 < len(f1) - 1 < deg:
+                f2 = _poly_divide_exact(h, f1)
+                split(f1)
+                split(f2)
+                return
+        raise ArithmeticError("quartic splitting failed")
+
+    split(g)
+    return roots
+
+
+def _poly_divide_exact(a: list[Fq2], b: list[Fq2]) -> list[Fq2]:
+    """a / b for exact division, both monic-ish."""
+    a = list(a)
+    out = [Fq2.zero()] * (len(a) - len(b) + 1)
+    binv = b[-1].inverse()
+    while len(a) >= len(b):
+        coef = a[-1] * binv
+        shift = len(a) - len(b)
+        out[shift] = coef
+        for i in range(len(b)):
+            a[shift + i] = a[shift + i] - coef * b[i]
+        while len(a) > 1 and a[-1].is_zero():
+            a.pop()
+        if len(a) == 1 and a[0].is_zero():
+            break
+    return out
+
+
+def _derive_isogeny() -> tuple[Fq2, Fq2, Fq2, Fq, Fq]:
+    """Find the kernel x-coordinate x0 of the 3-isogeny E2' → E2 and the
+    Vélu parameters (x0, t, u) plus the isomorphism scale:
+
+        X(x)  = x + t/(x−x0) + u/(x−x0)²,   t = 2(3x0² + A'), u = 4y0²
+        Y(x,y)= y·X'(x),  X'(x) = 1 − t/(x−x0)² − 2u/(x−x0)³
+
+    Vélu's codomain is y² = x³ + (A'−5t)x + (B'−7(u+t·x0)). For BLS12-381 it
+    comes out as y² = x³ + λ⁶·4(1+u) with λ = 3, so the map onto E2 itself is
+    the composition with (x, y) → (x/λ², y/λ³). The sign of λ (equivalently,
+    post-composition with negation) is fixed to match RFC 9380's map — pinned
+    empirically against the reference's interop deposit signature vector
+    (beacon-node/test/e2e/interop/genesisState.test.ts).
+    """
+    # ψ₃(x) = 3x⁴ + 6A'x² + 12B'x − A'²
+    three = Fq2.from_ints(3, 0)
+    six = Fq2.from_ints(6, 0)
+    twelve = Fq2.from_ints(12, 0)
+    poly = [
+        -(A_PRIME * A_PRIME),
+        twelve * B_PRIME,
+        six * A_PRIME,
+        Fq2.zero(),
+        three,
+    ]
+    # normalize monic for root finding
+    inv = poly[-1].inverse()
+    poly_monic = [c * inv for c in poly]
+    candidates = []
+    for x0 in _find_quartic_roots(poly_monic):
+        y0_sq = x0 * x0 * x0 + A_PRIME * x0 + B_PRIME
+        t = (x0 * x0).mul_scalar(Fq(6)) + A_PRIME + A_PRIME
+        u = y0_sq.mul_scalar(Fq(4))
+        a_new = A_PRIME - t.mul_scalar(Fq(5))
+        b_new = B_PRIME - (u + t * x0).mul_scalar(Fq(7))
+        if not a_new.is_zero():
+            continue
+        # b_new must be λ⁶ · B2 for some λ ∈ Fq; check small integer λ.
+        for lam_int in (1, 2, 3, 4, 5, 6, 7, 8, 9):
+            lam = Fq(lam_int)
+            if B2.mul_scalar(lam.pow(6)) == b_new:
+                candidates.append((x0, t, u, lam))
+                break
+    if not candidates:
+        raise ArithmeticError("no 3-isogeny E2' -> E2 found")
+    candidates.sort(key=lambda c: (c[0].c1.n, c[0].c0.n))
+    x0, t, u, lam = candidates[0]
+    # RFC 9380's isogeny corresponds to λ = −3 (not +3): with +3 the final
+    # hash point comes out negated. Pinned empirically by reproducing the
+    # reference's interop deposit signature byte-for-byte (validator 0,
+    # sig 0xa95af8ff..., beacon-node/test/e2e/interop/genesisState.test.ts).
+    lam = -lam
+    inv_l2 = lam.pow(2).inverse()
+    inv_l3 = lam.pow(3).inverse()
+    return x0, t, u, inv_l2, inv_l3
+
+
+_ISO_X0, _ISO_T, _ISO_U, _ISO_INV_L2, _ISO_INV_L3 = _derive_isogeny()
+
+
+def iso_map_to_e2(x: Fq2, y: Fq2) -> tuple[Fq2, Fq2]:
+    """Apply the derived 3-isogeny E2' → E2 (affine): Vélu map composed with
+    the scaling isomorphism (x, y) → (x/λ², y/λ³)."""
+    d = x - _ISO_X0
+    d_inv = d.inverse()
+    d_inv2 = d_inv * d_inv
+    d_inv3 = d_inv2 * d_inv
+    xx = x + _ISO_T * d_inv + _ISO_U * d_inv2
+    dx = Fq2.one() - _ISO_T * d_inv2 - (_ISO_U + _ISO_U) * d_inv3
+    return xx.mul_scalar(_ISO_INV_L2), (y * dx).mul_scalar(_ISO_INV_L3)
+
+
+def simplified_swu(u: Fq2) -> tuple[Fq2, Fq2]:
+    """RFC 9380 §6.6.2 simplified SWU onto E2' (A'B' ≠ 0)."""
+    A, B, Z = A_PRIME, B_PRIME, Z_SSWU
+    u2 = u * u
+    zu2 = Z * u2
+    tv = zu2 * zu2 + zu2  # Z²u⁴ + Zu²
+    if tv.is_zero():
+        x1 = B * (Z * A).inverse()  # x1 = B / (Z·A)
+    else:
+        x1 = (-B) * A.inverse() * (Fq2.one() + tv.inverse())
+    gx1 = x1 * x1 * x1 + A * x1 + B
+    y1 = gx1.sqrt()
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = zu2 * x1
+        gx2 = x2 * x2 * x2 + A * x2 + B
+        y2 = gx2.sqrt()
+        assert y2 is not None, "SSWU: neither gx1 nor gx2 is square"
+        x, y = x2, y2
+    if y.sgn0() != u.sgn0():
+        y = -y
+    return x, y
+
+
+def map_to_curve_g2(u: Fq2) -> PointG2:
+    x, y = simplified_swu(u)
+    xx, yy = iso_map_to_e2(x, y)
+    return PointG2(xx, yy, Fq2.one())
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> PointG2:
+    """Full hash_to_curve (random-oracle variant): two field draws, two maps,
+    point addition, cofactor clearing."""
+    u0, u1 = hash_to_field_fq2(msg, 2, dst)
+    q0 = map_to_curve_g2(u0)
+    q1 = map_to_curve_g2(u1)
+    return clear_cofactor_g2(q0 + q1)

@@ -1,0 +1,150 @@
+"""Optimal ate pairing on BLS12-381 (oracle tier).
+
+e: G1 × G2 → μ_r ⊂ Fq12. Miller loop over the (absolute) BLS parameter with
+a final conjugation for its sign, then final exponentiation. Two final-exp
+paths are provided: a naive big-int pow (obviously correct; used to validate)
+and the fast easy-part + Hayashida–Hayasaka–Teruya hard-part used in
+production libraries. The TPU kernels (lodestar_tpu/ops) mirror the fast path
+and are differentially tested against this module.
+
+The Miller loop here works entirely in E(Fq12) with generic affine line
+evaluations via the untwist map — slow but transparently matching the
+textbook definition.
+"""
+
+from __future__ import annotations
+
+from .curve import PointG1, PointG2
+from .fields import P, R, X_PARAM, Fq, Fq2, Fq6, Fq12
+
+# |x| for the Miller loop
+X_ABS = abs(X_PARAM)
+X_BITS = bin(X_ABS)[2:]
+
+# w⁻² and w⁻³ as Fq12 elements for the untwist map
+_W = Fq12(Fq6.zero(), Fq6.one())  # w
+_W2_INV = (_W * _W).inverse()
+_W3_INV = (_W * _W * _W).inverse()
+
+
+def _embed_fq(x) -> Fq12:
+    return Fq12(Fq6(Fq2(x, type(x)(0)), Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def _embed_fq2(x: Fq2) -> Fq12:
+    return Fq12(Fq6(x, Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def untwist(q: PointG2) -> tuple[Fq12, Fq12]:
+    """E'(Fq2) → E(Fq12): (x, y) → (x/w², y/w³)."""
+    aff = q.to_affine()
+    assert aff is not None, "untwist of infinity"
+    x, y = aff
+    return (_embed_fq2(x) * _W2_INV, _embed_fq2(y) * _W3_INV)
+
+
+def miller_loop(p: PointG1, q: PointG2) -> Fq12:
+    """Miller loop f_{|x|,Q}(P), conjugated for the negative parameter.
+
+    Returns 1 for degenerate inputs (either point at infinity), matching the
+    convention e(O, Q) = e(P, O) = 1.
+    """
+    if p.is_infinity() or q.is_infinity():
+        return Fq12.one()
+
+    paff = p.to_affine()
+    assert paff is not None
+    xp = _embed_fq(paff[0])
+    yp = _embed_fq(paff[1])
+
+    xq, yq = untwist(q)
+    xt, yt = xq, yq
+    f = Fq12.one()
+    three = _embed_fq(Fq(3))
+
+    for bit in X_BITS[1:]:
+        # doubling step: tangent line at T evaluated at P
+        slope = (xt * xt) * three * (yt + yt).inverse()
+        line = yp - yt - slope * (xp - xt)
+        f = f * f * line
+        x_new = slope * slope - xt - xt
+        y_new = slope * (xt - x_new) - yt
+        xt, yt = x_new, y_new
+        if bit == "1":
+            # addition step: chord through T and Q evaluated at P.
+            # T = kQ with 1 < k < |x| < r and Q of prime order r, so T
+            # can never equal ±Q here.
+            if xt == xq:
+                raise ArithmeticError("Miller loop degenerate addition (T == ±Q)")
+            slope = (yq - yt) * (xq - xt).inverse()
+            line = yp - yt - slope * (xp - xt)
+            f = f * line
+            x_new = slope * slope - xt - xq
+            y_new = slope * (xt - x_new) - yt
+            xt, yt = x_new, y_new
+
+    # Negative BLS parameter: conjugate (f^(p⁶) ≡ f⁻¹ modulo the final
+    # exponentiation), the standard convention in production pairing code.
+    return f.conjugate()
+
+
+FINAL_EXP_POWER = (P**12 - 1) // R
+
+
+def final_exponentiation_naive(f: Fq12) -> Fq12:
+    """f^((p¹²−1)/r) by direct square-and-multiply. Slow, obviously correct."""
+    return f.pow(FINAL_EXP_POWER)
+
+
+def _pow_x_abs(f: Fq12) -> Fq12:
+    """f^|x| (x = BLS parameter, 64-bit)."""
+    return f.pow(X_ABS)
+
+
+def final_exponentiation(f: Fq12) -> Fq12:
+    """Fast final exponentiation.
+
+    Note: the HHT hard-part decomposition (x−1)²(x+p)(x²+p²−1) + 3 equals
+    3·(p⁴−p²+1)/r, so this computes pairing(...)³ — a fixed power coprime to
+    r, preserving all verification equations (same convention as production
+    pairing libraries). Differential tests vs the naive path account for the
+    cube.
+
+    Easy part: f ← f^(p⁶−1)(p²+1). Hard part computed as
+      b = (f^((x−1)²))^x · frob(f^((x−1)²))
+      result = b^(x²) · frob²(b) · b⁻¹ · f³
+    using conj for inverses (valid in the cyclotomic subgroup after the easy
+    part) and conj∘pow for the negative x.
+    """
+    # easy part
+    f = f.conjugate() * f.inverse()  # f^(p^6 - 1)
+    f = f.frobenius(2) * f  # ^(p^2 + 1); now f is in the cyclotomic subgroup
+
+    def pow_x(g: Fq12) -> Fq12:
+        # g^x with x negative: g^|x| then invert (conjugate — cyclotomic)
+        return _pow_x_abs(g).conjugate()
+
+    def pow_x_minus_1(g: Fq12) -> Fq12:
+        # g^(x-1) = g^x · g^-1
+        return pow_x(g) * g.conjugate()
+
+    a = pow_x_minus_1(pow_x_minus_1(f))  # f^((x-1)^2)
+    b = pow_x(a) * a.frobenius(1)  # a^(x+p)
+    # b^(x² + p² − 1)
+    c = pow_x(pow_x(b)) * b.frobenius(2) * b.conjugate()
+    return c * f * f * f  # · f^3
+
+
+def pairing(p: PointG1, q: PointG2, fast: bool = True) -> Fq12:
+    f = miller_loop(p, q)
+    return final_exponentiation(f) if fast else final_exponentiation_naive(f)
+
+
+def multi_pairing(pairs: list[tuple[PointG1, PointG2]]) -> Fq12:
+    """Π e(P_i, Q_i): product of Miller loops, one shared final exponentiation
+    — the batch-verification primitive (reference analog: blst
+    verifyMultipleSignatures aggregation, chain/bls/maybeBatch.ts)."""
+    acc = Fq12.one()
+    for p, q in pairs:
+        acc = acc * miller_loop(p, q)
+    return final_exponentiation(acc)
