@@ -149,6 +149,8 @@ class Point(Generic[F]):
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Point):
             return NotImplemented
+        if type(self.x) is not type(other.x):  # G1 vs G2: never equal
+            return False
         # (X1/Z1², Y1/Z1³) == (X2/Z2², Y2/Z2³) cross-multiplied
         if self.is_infinity() or other.is_infinity():
             return self.is_infinity() and other.is_infinity()

@@ -1,0 +1,13 @@
+"""TPU-native BLS12-381 kernels (the north star per BASELINE.json).
+
+This package is the device tier: fixed-width limb arithmetic over the
+381-bit base field mapped onto int32 lanes, field towers, curve groups,
+the optimal ate pairing, and the batched signature-set verification kernel
+— all pure JAX (jnp/lax), jit-compatible, vmap-batchable, and shardable
+over a `jax.sharding.Mesh`.
+
+Role in the architecture: the reference offloads BLS work to a pool of
+CPU worker threads (`beacon-node/src/chain/bls/multithread/index.ts`);
+here the same `IBlsVerifier` boundary dispatches to these kernels instead,
+with `lodestar_tpu/bls` (pure-Python big ints) as the correctness oracle.
+"""

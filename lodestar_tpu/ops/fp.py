@@ -1,0 +1,196 @@
+"""Base-field Fp arithmetic on int32 limb vectors (device tier).
+
+Montgomery-form arithmetic over p (BLS12-381) with the 32x12-bit limb
+layout from `limbs.py`. Everything here is pure JAX: jit-compatible,
+shape-polymorphic over leading batch axes (limb axis is always last), and
+safe to `vmap`/`shard_map`.
+
+Design notes (why this maps well to TPU):
+- All hot paths are fixed-trip `lax.scan`s or statically unrolled loops:
+  no data-dependent control flow, so XLA compiles one fused kernel.
+- The schoolbook product is 32 vector multiply-adds on the VPU; the
+  Montgomery reduction is a 32-step scan whose body is one vector
+  multiply-add — sequential over limbs, parallel over the batch, which is
+  where the throughput comes from (BASELINE.json wants batched signature
+  sets, not single-signature latency).
+- Values range over [0, 2p) between ops (lazy reduction); every op's
+  output respects that invariant, and `canonical` gives the < p form.
+
+Oracle: `lodestar_tpu/bls/fields.Fq` (differential tests in
+tests/test_ops_fp.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..bls.fields import P as _P_INT
+from .limbs import (
+    LIMB_BITS,
+    LIMB_MASK,
+    N_LIMBS,
+    N0,
+    ONE_MONT_LIMBS,
+    P_LIMBS,
+    R2_LIMBS,
+    TWO_P_LIMBS,
+)
+
+_P = jnp.asarray(P_LIMBS)
+_TWO_P = jnp.asarray(TWO_P_LIMBS)
+_R2 = jnp.asarray(R2_LIMBS)
+_ONE_MONT = jnp.asarray(ONE_MONT_LIMBS)
+
+
+def carry_scan(t: jnp.ndarray) -> jnp.ndarray:
+    """Exact carry/borrow propagation -> canonical 12-bit limbs.
+
+    Works for signed inputs: `>>` is arithmetic shift and `& MASK` is the
+    positive remainder, so borrows ripple as negative carries. The final
+    carry out of the top limb is dropped (callers guarantee the value fits
+    384 bits and is non-negative).
+    """
+    tt = jnp.moveaxis(t, -1, 0)
+
+    def step(carry, col):
+        v = col + carry
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    _, out = lax.scan(step, jnp.zeros(tt.shape[1:], jnp.int32), tt)
+    return jnp.moveaxis(out, 0, -1)
+
+
+def _lex_ge(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """a >= m comparing canonical limb vectors (trailing limb axis)."""
+    diff = a - m
+    nz = diff != 0
+    pos = diff > 0
+    rev_nz = jnp.flip(nz, axis=-1)
+    first = jnp.argmax(rev_nz, axis=-1)  # index (from top) of highest nonzero
+    idx = (N_LIMBS - 1 - first)[..., None]
+    top_sign = jnp.take_along_axis(pos, idx, axis=-1)[..., 0]
+    return jnp.where(nz.any(axis=-1), top_sign, True)
+
+
+def _cond_sub(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """a - m if a >= m else a; a canonical, result canonical."""
+    ge = _lex_ge(a, m)
+    return carry_scan(a - jnp.where(ge[..., None], m, 0))
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cond_sub(carry_scan(a + b), _TWO_P)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cond_sub(carry_scan(a - b + _TWO_P), _TWO_P)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def double(a: jnp.ndarray) -> jnp.ndarray:
+    return add(a, a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product REDC(a*b): inputs < 2p, output < 2p.
+
+    Schoolbook convolution into 64 uncarried int32 columns (each < 2^29),
+    then word-by-word Montgomery reduction as a 32-step scan. Peak column
+    value stays < 2^31 (see limbs.py for the bound).
+    """
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (N_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (N_LIMBS,))
+    t = jnp.zeros(batch + (2 * N_LIMBS,), dtype=jnp.int32)
+    for i in range(N_LIMBS):  # static unroll: 32 vector multiply-adds
+        t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+
+    def redc_step(t, i):
+        chunk = lax.dynamic_slice_in_dim(t, i, N_LIMBS, axis=-1)
+        m = (chunk[..., 0:1] * N0) & LIMB_MASK
+        chunk = chunk + m * _P
+        carry = chunk[..., 0:1] >> LIMB_BITS  # low limb is ≡ 0 mod 2^12 now
+        chunk = chunk.at[..., 1:2].add(carry)
+        chunk = chunk.at[..., 0:1].set(0)
+        return lax.dynamic_update_slice_in_dim(t, chunk, i, axis=-1), None
+
+    t, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
+    return carry_scan(t[..., N_LIMBS:])
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Normal-domain canonical limbs -> Montgomery form."""
+    return mul(a, _R2)
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery form -> canonical normal-domain limbs (< p)."""
+    one = jnp.zeros(N_LIMBS, jnp.int32).at[0].set(1)
+    return _cond_sub(mul(a, one), _P)
+
+
+def canonical(a: jnp.ndarray) -> jnp.ndarray:
+    """Reduce the [0, 2p) representative to the unique [0, p) form."""
+    return _cond_sub(a, _P)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, broadcasting cond over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def zero(batch: tuple = ()) -> jnp.ndarray:
+    return jnp.zeros(batch + (N_LIMBS,), jnp.int32)
+
+
+def one_mont(batch: tuple = ()) -> jnp.ndarray:
+    return jnp.broadcast_to(_ONE_MONT, batch + (N_LIMBS,))
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """MSB-first bit array of a positive exponent (static)."""
+    bits = bin(e)[2:]
+    return np.frombuffer(bits.encode(), np.uint8).astype(np.int32) - ord("0")
+
+
+def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a static exponent, square-and-multiply over a bit scan."""
+    if e == 0:
+        return one_mont(a.shape[:-1])
+    bits = jnp.asarray(_exp_bits(e))
+
+    def step(acc, bit):
+        acc = square(acc)
+        acc = jnp.where(bit != 0, mul(acc, a), acc)
+        return acc, None
+
+    # first bit is always 1: start from a
+    acc, _ = lax.scan(step, a, bits[1:])
+    return acc
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Fermat inverse a^(p-2); a must be nonzero (0 maps to 0)."""
+    return pow_const(a, _P_INT - 2)
+
+
+def sqrt_candidate(a: jnp.ndarray) -> jnp.ndarray:
+    """a^((p+1)/4) — a square root iff a is a QR (p ≡ 3 mod 4)."""
+    return pow_const(a, (_P_INT + 1) // 4)

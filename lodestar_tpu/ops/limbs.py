@@ -1,0 +1,72 @@
+"""Limb representation of Fp (BLS12-381 base field) for int32 TPU lanes.
+
+Representation choice (SURVEY.md §7 hard part #1): TPUs have no 64-bit
+integer multiply worth using, so a field element is a little-endian vector
+of 32 limbs x 12 bits held in int32. Schoolbook products of 12-bit limbs
+are < 2^24 and a full 32-term convolution column stays < 2^29, so every
+intermediate of the Montgomery pipeline fits signed int32 with headroom.
+
+Values are kept in Montgomery form (a*R mod p, R = 2^384) and allowed to
+range over [0, 2p) between operations (lazy reduction — same trick blst
+uses); `canonical()` produces the unique representative < p.
+
+All device functions in ops/ treat the trailing axis (size 32) as the limb
+axis and broadcast over any leading batch axes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls.fields import P
+
+LIMB_BITS = 12
+N_LIMBS = 32
+LIMB_MASK = (1 << LIMB_BITS) - 1
+DTYPE = np.int32
+
+# Montgomery radix
+R_MONT = 1 << (LIMB_BITS * N_LIMBS)  # 2^384
+R2 = (R_MONT * R_MONT) % P  # for to_mont: a*R = REDC(a * R2)
+# -p^-1 mod 2^12 (p is odd)
+N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int -> (32,) int32 little-endian 12-bit limbs. x must fit 384 bits."""
+    if not 0 <= x < R_MONT:
+        raise ValueError("value out of 384-bit range")
+    out = np.zeros(N_LIMBS, dtype=DTYPE)
+    for i in range(N_LIMBS):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """(…, 32) limbs -> Python int (single element only)."""
+    arr = np.asarray(limbs)
+    if arr.ndim != 1:
+        raise ValueError("limbs_to_int takes a single element")
+    acc = 0
+    for i in reversed(range(N_LIMBS)):
+        acc = (acc << LIMB_BITS) | int(arr[i])
+    return acc
+
+
+# Device-side constants (plain numpy; jnp will const-fold them under jit)
+P_LIMBS = int_to_limbs(P)
+TWO_P_LIMBS = int_to_limbs(2 * P)
+R2_LIMBS = int_to_limbs(R2)
+ONE_MONT_LIMBS = int_to_limbs(R_MONT % P)  # 1 in Montgomery form
+ZERO_LIMBS = np.zeros(N_LIMBS, dtype=DTYPE)
+
+
+def fp_to_mont_host(x: int) -> np.ndarray:
+    """Host-side: normal-domain int -> Montgomery-form limbs."""
+    return int_to_limbs((x * R_MONT) % P)
+
+
+def fp_from_mont_host(limbs) -> int:
+    """Host-side: Montgomery-form limbs -> normal-domain int."""
+    return (limbs_to_int(limbs) * pow(R_MONT, -1, P)) % P
