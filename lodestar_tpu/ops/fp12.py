@@ -1,0 +1,191 @@
+"""Fp12 = Fp6[w]/(w² − v) on int32 limb vectors (device tier).
+
+Element shape: (..., 2, 3, 2, 32) — axis -4 indexes (c0, c1) of c0 + c1·w.
+A full multiplication is 3 Fp6 products stacked into ONE fp6.mul call
+(= 54 Fp products in a single Montgomery scan). The pairing's line update
+uses the sparse `mul_by_line` (15 Fp2 products) instead of a full mul.
+
+Frobenius maps use the flattened Fq2[w]/(w⁶ − ξ) view with γ constants
+computed once on the host by the oracle (`bls.fields._FROB_GAMMA`).
+
+Oracle: `lodestar_tpu/bls/fields.Fq12`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..bls import fields as _f
+from . import fp, fp2, fp6
+from .limbs import N_LIMBS, fp_to_mont_host
+
+
+def _split(a):
+    return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+
+def _join(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _bcast(a, b):
+    batch = jnp.broadcast_shapes(a.shape[:-4], b.shape[:-4])
+    return (
+        jnp.broadcast_to(a, batch + a.shape[-4:]),
+        jnp.broadcast_to(b, batch + b.shape[-4:]),
+    )
+
+
+def add(a, b):
+    return fp.add(a, b)
+
+
+def mul(a, b):
+    """Karatsuba over w: c0 = v0 + v·v1, c1 = (a0+a1)(b0+b1) − v0 − v1."""
+    a, b = _bcast(a, b)
+    a0, a1 = _split(a)
+    b0, b1 = _split(b)
+    big_a = jnp.stack([a0, a1, fp6.add(a0, a1)], axis=0)
+    big_b = jnp.stack([b0, b1, fp6.add(b0, b1)], axis=0)
+    v = fp6.mul(big_a, big_b)
+    v0, v1, v01 = v[0], v[1], v[2]
+    c0 = fp6.add(v0, fp6.mul_by_v(v1))
+    c1 = fp6.sub(fp6.sub(v01, v0), v1)
+    return _join(c0, c1)
+
+
+def square(a):
+    """Complex squaring: c0 = (a0+a1)(a0+v·a1) − v0 − v·v0, c1 = 2v0."""
+    a0, a1 = _split(a)
+    big_a = jnp.stack([a0, fp6.add(a0, a1)], axis=0)
+    big_b = jnp.stack([a1, fp6.add(a0, fp6.mul_by_v(a1))], axis=0)
+    v = fp6.mul(big_a, big_b)
+    v0, mixed = v[0], v[1]
+    c0 = fp6.sub(fp6.sub(mixed, v0), fp6.mul_by_v(v0))
+    c1 = fp6.add(v0, v0)
+    return _join(c0, c1)
+
+
+def conj(a):
+    """x^(p⁶): negate the w component."""
+    a0, a1 = _split(a)
+    return _join(a0, fp6.neg(a1))
+
+
+def inv(a):
+    """(c0 + c1w)⁻¹ = (c0 − c1w)/(c0² − v·c1²)."""
+    a0, a1 = _split(a)
+    sq = fp6.mul(jnp.stack([a0, a1], axis=0), jnp.stack([a0, a1], axis=0))
+    denom = fp6.sub(sq[0], fp6.mul_by_v(sq[1]))
+    dinv = fp6.inv(denom)
+    out = fp6.mul(jnp.stack([a0, a1], axis=0), dinv[None])
+    return _join(out[0], fp6.neg(out[1]))
+
+
+def mul_by_line(f, l0, l1, l2):
+    """f · (l0 + l1·w² + l2·w³), l_i ∈ Fp2 — the sparse pairing-line update.
+
+    In tower coordinates the line is (A, B) with A = (l0, l1, 0),
+    B = (0, l2, 0); Karatsuba needs f0·A, f1·B, (f0+f1)(A+B) where
+    A+B = (l0, l1+l2, 0) — 15 Fp2 products in one stacked call.
+    """
+    f0, f1 = _split(f)
+    f00, f01, f02 = fp6._split(f0)
+    f10, f11, f12 = fp6._split(f1)
+    g = fp6.add(f0, f1)
+    g0, g1, g2 = fp6._split(g)
+    s = fp2.add(l1, l2)
+    lhs = jnp.stack(
+        [f00, f02, f00, f01, f01, f02, f12, f10, f11, g0, g2, g0, g1, g1, g2],
+        axis=0,
+    )
+    rhs = jnp.stack(
+        [l0, l1, l1, l0, l1, l0, l2, l2, l2, l0, s, s, l0, s, l0],
+        axis=0,
+    )
+    rhs = jnp.broadcast_to(rhs, lhs.shape)
+    p = fp2.mul(lhs, rhs)
+    # t0 = f0·A over v-coords
+    t0 = fp6._join(
+        fp2.add(p[0], fp2.mul_by_xi(p[1])),  # f00·l0 + ξ f02·l1
+        fp2.add(p[2], p[3]),  # f00·l1 + f01·l0
+        fp2.add(p[4], p[5]),  # f01·l1 + f02·l0
+    )
+    # t1 = f1·B = f1·(l2 v) = ξ f12 l2 + f10 l2 v + f11 l2 v²
+    t1 = fp6._join(fp2.mul_by_xi(p[6]), p[7], p[8])
+    # t2 = (f0+f1)(A+B), A+B = (l0, s, 0)
+    t2 = fp6._join(
+        fp2.add(p[9], fp2.mul_by_xi(p[10])),
+        fp2.add(p[11], p[12]),
+        fp2.add(p[13], p[14]),
+    )
+    c0 = fp6.add(t0, fp6.mul_by_v(t1))
+    c1 = fp6.sub(fp6.sub(t2, t0), t1)
+    return _join(c0, c1)
+
+
+# --- Frobenius -------------------------------------------------------------
+
+def _gamma_const() -> np.ndarray:
+    """(3, 6, 2, 32) Montgomery limbs: γ_i^(k) = ξ^(i(p^k−1)/6), k=1..3."""
+    out = np.zeros((3, 6, 2, N_LIMBS), np.int32)
+    for k in (1, 2, 3):
+        for i, g in enumerate(_f._FROB_GAMMA[k]):
+            out[k - 1, i, 0] = fp_to_mont_host(g.c0.n)
+            out[k - 1, i, 1] = fp_to_mont_host(g.c1.n)
+    return out
+
+
+_GAMMA = _gamma_const()
+
+
+def _to_w(a):
+    """(..., 2, 3, 2, 32) tower layout → (..., 6, 2, 32) w-coefficients."""
+    a0, a1 = _split(a)
+    d = [
+        a0[..., 0, :, :], a1[..., 0, :, :],
+        a0[..., 1, :, :], a1[..., 1, :, :],
+        a0[..., 2, :, :], a1[..., 2, :, :],
+    ]
+    return jnp.stack(d, axis=-3)
+
+
+def _from_w(d):
+    c0 = jnp.stack([d[..., 0, :, :], d[..., 2, :, :], d[..., 4, :, :]], axis=-3)
+    c1 = jnp.stack([d[..., 1, :, :], d[..., 3, :, :], d[..., 5, :, :]], axis=-3)
+    return _join(c0, c1)
+
+
+def frobenius(a, power: int):
+    """x^(p^power), power ∈ {1,2,3}: conj^power per w-coeff, then ·γ_i."""
+    if power not in (1, 2, 3):
+        raise ValueError("frobenius power must be 1, 2 or 3")
+    d = _to_w(a)
+    if power % 2 == 1:
+        d = jnp.concatenate([d[..., 0:1, :], fp.neg(d[..., 1:2, :])], axis=-2)
+    gammas = jnp.asarray(_GAMMA[power - 1])  # (6, 2, 32)
+    d = fp2.mul(d, gammas)
+    return _from_w(d)
+
+
+def is_one(a):
+    return eq(a, one(a.shape[:-4]))
+
+
+def eq(a, b):
+    return jnp.all(
+        fp.canonical(a) == fp.canonical(b), axis=(-1, -2, -3, -4)
+    )
+
+
+def select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+def zero(batch: tuple = ()):
+    return jnp.zeros(batch + (2, 3, 2, N_LIMBS), jnp.int32)
+
+
+def one(batch: tuple = ()):
+    return _join(fp6.one(batch), fp6.zero(batch))

@@ -1,0 +1,121 @@
+"""Fp2 = Fp[u]/(u²+1) on int32 limb vectors (device tier).
+
+An Fp2 element is a (..., 2, 32) int32 array: axis -2 indexes (c0, c1) of
+c0 + c1·u, axis -1 is the 12-bit limb axis from `limbs.py`. All leading axes
+are batch axes.
+
+Kernel-shape note: the Karatsuba product runs as ONE stacked `fp.mul` call
+(3 base-field products stacked on a new leading axis), so a tower
+multiplication costs a single Montgomery-reduction scan over a 3x-wider
+batch — sequential depth stays constant while the VPU lanes fill up. The
+same trick compounds up the tower (fp6, fp12).
+
+Oracle: `lodestar_tpu/bls/fields.Fq2`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import fp
+from .limbs import N_LIMBS
+
+
+def _split(a):
+    return a[..., 0, :], a[..., 1, :]
+
+
+def _join(c0, c1):
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def add(a, b):
+    return fp.add(a, b)  # fp ops are elementwise over all leading axes
+
+
+def sub(a, b):
+    return fp.sub(a, b)
+
+
+def neg(a):
+    return fp.neg(a)
+
+
+def double(a):
+    return fp.add(a, a)
+
+
+def _bcast(a, b):
+    batch = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+    return (
+        jnp.broadcast_to(a, batch + a.shape[-2:]),
+        jnp.broadcast_to(b, batch + b.shape[-2:]),
+    )
+
+
+def mul(a, b):
+    """Karatsuba: 3 Fp products in one stacked fp.mul call."""
+    a, b = _bcast(a, b)
+    a0, a1 = _split(a)
+    b0, b1 = _split(b)
+    big_a = jnp.stack([a0, a1, fp.add(a0, a1)], axis=0)
+    big_b = jnp.stack([b0, b1, fp.add(b0, b1)], axis=0)
+    p = fp.mul(big_a, big_b)
+    p0, p1, p2 = p[0], p[1], p[2]
+    c0 = fp.sub(p0, p1)  # a0b0 - a1b1
+    c1 = fp.sub(p2, fp.add(p0, p1))  # (a0+a1)(b0+b1) - a0b0 - a1b1
+    return _join(c0, c1)
+
+
+def square(a):
+    """(a0+a1u)² : c0 = (a0+a1)(a0−a1), c1 = 2·a0·a1 — 2 stacked Fp muls."""
+    a0, a1 = _split(a)
+    big_a = jnp.stack([fp.add(a0, a1), a0], axis=0)
+    big_b = jnp.stack([fp.sub(a0, a1), fp.add(a1, a1)], axis=0)
+    p = fp.mul(big_a, big_b)
+    return _join(p[0], p[1])
+
+
+def mul_fp(a, k):
+    """Fp2 × Fp scalar: k has shape (..., 32)."""
+    return fp.mul(a, k[..., None, :])
+
+
+def mul_by_xi(a):
+    """Multiply by the Fp6 non-residue ξ = 1 + u: (c0 − c1) + (c0 + c1)u."""
+    a0, a1 = _split(a)
+    return _join(fp.sub(a0, a1), fp.add(a0, a1))
+
+
+def conj(a):
+    a0, a1 = _split(a)
+    return _join(a0, fp.neg(a1))
+
+
+def inv(a):
+    """(a0 − a1u)/(a0² + a1²). Zero maps to zero (callers mask infinity)."""
+    a0, a1 = _split(a)
+    p = fp.mul(jnp.stack([a0, a1], axis=0), jnp.stack([a0, a1], axis=0))
+    norm_inv = fp.inv(fp.add(p[0], p[1]))
+    q = fp.mul(jnp.stack([a0, a1], axis=0), norm_inv[None])
+    return _join(q[0], fp.neg(q[1]))
+
+
+def is_zero(a):
+    return jnp.all(fp.canonical(a) == 0, axis=(-1, -2))
+
+
+def eq(a, b):
+    return jnp.all(fp.canonical(a) == fp.canonical(b), axis=(-1, -2))
+
+
+def select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def zero(batch: tuple = ()):
+    return jnp.zeros(batch + (2, N_LIMBS), jnp.int32)
+
+
+def one(batch: tuple = ()):
+    return _join(fp.one_mont(batch), fp.zero(batch))

@@ -1,0 +1,121 @@
+"""Fp6 = Fp2[v]/(v³ − ξ) on int32 limb vectors (device tier).
+
+Element shape: (..., 3, 2, 32) — axis -3 indexes (c0, c1, c2) of
+c0 + c1·v + c2·v². The 6-product Karatsuba multiplication stacks into ONE
+fp2.mul call (which itself is one fp.mul call → 18 Fp products in a single
+Montgomery scan).
+
+Oracle: `lodestar_tpu/bls/fields.Fq6`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import fp, fp2
+from .limbs import N_LIMBS
+
+
+def _split(a):
+    return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+
+def _join(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def add(a, b):
+    return fp.add(a, b)
+
+
+def sub(a, b):
+    return fp.sub(a, b)
+
+
+def neg(a):
+    return fp.neg(a)
+
+
+def _bcast(a, b):
+    batch = jnp.broadcast_shapes(a.shape[:-3], b.shape[:-3])
+    return (
+        jnp.broadcast_to(a, batch + a.shape[-3:]),
+        jnp.broadcast_to(b, batch + b.shape[-3:]),
+    )
+
+
+def mul(a, b):
+    """Toom/Karatsuba interpolation: 6 Fp2 products, one stacked call.
+
+    c0 = v0 + ξ((a1+a2)(b1+b2) − v1 − v2)
+    c1 = (a0+a1)(b0+b1) − v0 − v1 + ξ·v2
+    c2 = (a0+a2)(b0+b2) − v0 − v2 + v1
+    """
+    a, b = _bcast(a, b)
+    a0, a1, a2 = _split(a)
+    b0, b1, b2 = _split(b)
+    big_a = jnp.stack(
+        [a0, a1, a2, fp2.add(a1, a2), fp2.add(a0, a1), fp2.add(a0, a2)], axis=0
+    )
+    big_b = jnp.stack(
+        [b0, b1, b2, fp2.add(b1, b2), fp2.add(b0, b1), fp2.add(b0, b2)], axis=0
+    )
+    v = fp2.mul(big_a, big_b)
+    v0, v1, v2, v12, v01, v02 = v[0], v[1], v[2], v[3], v[4], v[5]
+    c0 = fp2.add(v0, fp2.mul_by_xi(fp2.sub(fp2.sub(v12, v1), v2)))
+    c1 = fp2.add(fp2.sub(fp2.sub(v01, v0), v1), fp2.mul_by_xi(v2))
+    c2 = fp2.add(fp2.sub(fp2.sub(v02, v0), v2), v1)
+    return _join(c0, c1, c2)
+
+
+def square(a):
+    return mul(a, a)
+
+
+def mul_by_v(a):
+    """v·(c0 + c1v + c2v²) = ξc2 + c0·v + c1·v²."""
+    a0, a1, a2 = _split(a)
+    return _join(fp2.mul_by_xi(a2), a0, a1)
+
+
+def mul_fp2(a, k):
+    """Fp6 × Fp2 scalar: k has shape (..., 2, 32)."""
+    return fp2.mul(a, k[..., None, :, :])
+
+
+def inv(a):
+    """Standard tower inversion (mirrors the oracle's Fq6.inverse)."""
+    a0, a1, a2 = _split(a)
+    p = fp2.mul(
+        jnp.stack([a0, a1, a2, a0, a1, a0], axis=0),
+        jnp.stack([a0, a2, a2, a1, a1, a2], axis=0),
+    )
+    sq0, p12, sq2, p01, sq1, p02 = p[0], p[1], p[2], p[3], p[4], p[5]
+    t0 = fp2.sub(sq0, fp2.mul_by_xi(p12))  # a0² − ξ a1a2
+    t1 = fp2.sub(fp2.mul_by_xi(sq2), p01)  # ξ a2² − a0a1
+    t2 = fp2.sub(sq1, p02)  # a1² − a0a2
+    q = fp2.mul(jnp.stack([a0, a2, a1], axis=0), jnp.stack([t0, t1, t2], axis=0))
+    denom = fp2.add(q[0], fp2.mul_by_xi(fp2.add(q[1], q[2])))
+    dinv = fp2.inv(denom)
+    out = fp2.mul(jnp.stack([t0, t1, t2], axis=0), dinv[None])
+    return _join(out[0], out[1], out[2])
+
+
+def is_zero(a):
+    return jnp.all(fp.canonical(a) == 0, axis=(-1, -2, -3))
+
+
+def eq(a, b):
+    return jnp.all(fp.canonical(a) == fp.canonical(b), axis=(-1, -2, -3))
+
+
+def select(cond, a, b):
+    return jnp.where(cond[..., None, None, None], a, b)
+
+
+def zero(batch: tuple = ()):
+    return jnp.zeros(batch + (3, 2, N_LIMBS), jnp.int32)
+
+
+def one(batch: tuple = ()):
+    return _join(fp2.one(batch), fp2.zero(batch), fp2.zero(batch))

@@ -1,0 +1,75 @@
+"""Host-side conversions between oracle objects and device limb arrays.
+
+The oracle tier (`lodestar_tpu/bls`) speaks Python big ints; the device tier
+(`lodestar_tpu/ops`) speaks (..., 32) int32 Montgomery limb vectors. These
+helpers cross that boundary — they run on the host only and are NOT
+jit-compatible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls.curve import PointG1, PointG2
+from ..bls.fields import Fq, Fq2, Fq6, Fq12
+from .limbs import N_LIMBS, fp_from_mont_host, fp_to_mont_host
+
+
+def fq_to_limbs(x: Fq) -> np.ndarray:
+    return fp_to_mont_host(x.n)
+
+
+def limbs_to_fq(a) -> Fq:
+    return Fq(fp_from_mont_host(np.asarray(a)))
+
+
+def fq2_to_limbs(x: Fq2) -> np.ndarray:
+    return np.stack([fp_to_mont_host(x.c0.n), fp_to_mont_host(x.c1.n)])
+
+
+def limbs_to_fq2(a) -> Fq2:
+    a = np.asarray(a)
+    return Fq2(limbs_to_fq(a[0]), limbs_to_fq(a[1]))
+
+
+def fq6_to_limbs(x: Fq6) -> np.ndarray:
+    return np.stack([fq2_to_limbs(x.c0), fq2_to_limbs(x.c1), fq2_to_limbs(x.c2)])
+
+
+def limbs_to_fq6(a) -> Fq6:
+    a = np.asarray(a)
+    return Fq6(limbs_to_fq2(a[0]), limbs_to_fq2(a[1]), limbs_to_fq2(a[2]))
+
+
+def fq12_to_limbs(x: Fq12) -> np.ndarray:
+    return np.stack([fq6_to_limbs(x.c0), fq6_to_limbs(x.c1)])
+
+
+def limbs_to_fq12(a) -> Fq12:
+    a = np.asarray(a)
+    return Fq12(limbs_to_fq6(a[0]), limbs_to_fq6(a[1]))
+
+
+def g1_affine_to_limbs(p: PointG1) -> tuple[np.ndarray, np.ndarray, bool]:
+    """→ (x, y) Montgomery limbs + infinity flag (coords zeroed at infinity)."""
+    aff = p.to_affine()
+    if aff is None:
+        z = np.zeros(N_LIMBS, np.int32)
+        return z, z.copy(), True
+    return fq_to_limbs(aff[0]), fq_to_limbs(aff[1]), False
+
+
+def g2_affine_to_limbs(p: PointG2) -> tuple[np.ndarray, np.ndarray, bool]:
+    """→ (x, y) each (2, 32) Montgomery limbs + infinity flag."""
+    aff = p.to_affine()
+    if aff is None:
+        z = np.zeros((2, N_LIMBS), np.int32)
+        return z, z.copy(), True
+    return fq2_to_limbs(aff[0]), fq2_to_limbs(aff[1]), False
+
+
+def scalar_to_bits(r: int, nbits: int) -> np.ndarray:
+    """Scalar → (nbits,) int32 bit vector, MSB first (device scan order)."""
+    if not 0 <= r < (1 << nbits):
+        raise ValueError("scalar out of range")
+    return np.array([(r >> (nbits - 1 - i)) & 1 for i in range(nbits)], np.int32)

@@ -1,0 +1,166 @@
+"""Optimal ate pairing on BLS12-381 — device tier (jit/vmap-able JAX).
+
+Re-implements the oracle (`bls/pairing.py`) the TPU way:
+- Q stays on the twist E'(Fp2) in homogeneous projective coordinates; lines
+  are evaluated through the untwist (x/w², y/w³) and scaled by w³ and by
+  Fp2 denominators — both annihilated by the final exponentiation (w^N = 1
+  since 6(p²−1) | N = (p¹²−1)/r), so no inversions inside the loop.
+- The Miller loop is ONE `lax.scan` over the 63 parameter bits; the rare
+  addition step (6 set bits in |x|) sits behind `lax.cond` with a scalar
+  (unbatched) predicate, so XLA keeps it a real branch and zero-bit
+  iterations skip the addition entirely — batched pairings share the branch
+  because the bit pattern is the same for every lane.
+- Final exponentiation: easy part (p⁶−1)(p²+1) then the HHT hard part,
+  matching the oracle's convention (computes pairing³ — harmless for
+  verification equations; see bls/pairing.py:104 docstring).
+
+Conventions (MUST match the oracle bit-for-bit — differential tests):
+miller_loop returns conj(f_{|x|,Q}(P)); e(O, Q) = e(P, O) = 1 handled by
+the caller via masks (`pairing_check` below).
+
+Reference analog: the blst pairing core behind verifyMultipleSignatures
+(`chain/bls/maybeBatch.ts:18-27` per SURVEY.md §2.3) — here it is a
+vmap'd kernel over signature sets instead of a worker-thread C call.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..bls.fields import X_PARAM
+from . import fp, fp2, fp6, fp12
+from .points import g2
+
+X_ABS = abs(X_PARAM)
+# MSB-first bits of |x| after the leading 1 (63 scan steps, 5 ones)
+_X_BITS_TAIL = np.array([int(b) for b in bin(X_ABS)[3:]], dtype=np.int32)
+
+
+def _line_dbl(t, xp_neg, yp):
+    """Tangent-line coefficients at T (projective, on twist), evaluated at
+    P = (xp, yp) ∈ G1 affine, line scaled by 2YZ²·w³:
+        l0 = 3X³ − 2Y²Z,  l1 = 3X²Z·(−xp),  l2 = 2YZ²·yp
+    (l = l0 + l1·w² + l2·w³). Expects xp_neg = −xp precomputed."""
+    x, y, z = t
+    xx = fp2.mul(x, x)
+    yy = fp2.mul(y, y)
+    zz = fp2.mul(z, z)
+    three_xx = fp2.add(fp2.add(xx, xx), xx)
+    l0 = fp2.sub(fp2.mul(three_xx, x), fp2.double(fp2.mul(yy, z)))
+    l1 = fp2.mul_fp(fp2.mul(three_xx, z), xp_neg)
+    l2 = fp2.mul_fp(fp2.double(fp2.mul(fp2.mul(y, z), z)), yp)
+    return l0, l1, l2
+
+
+def _line_add(t, q_aff, xp_neg, yp):
+    """Chord-line coefficients through T and affine Q, evaluated at P,
+    scaled by H·w³ with θ = Y − yq·Z, H = X − xq·Z:
+        l0 = θ·xq − yq·H,  l1 = θ·(−xp),  l2 = H·yp."""
+    x, y, z = t
+    xq, yq = q_aff
+    theta = fp2.sub(y, fp2.mul(yq, z))
+    h = fp2.sub(x, fp2.mul(xq, z))
+    l0 = fp2.sub(fp2.mul(theta, xq), fp2.mul(yq, h))
+    l1 = fp2.mul_fp(theta, xp_neg)
+    l2 = fp2.mul_fp(h, yp)
+    return l0, l1, l2
+
+
+def miller_loop(p_aff, q_aff):
+    """f = conj(f_{|x|,Q}(P)) for P ∈ G1 affine (xp, yp limbs), Q ∈ G2
+    affine ((2,32)-limb coords). Batched over leading axes; does NOT handle
+    infinity — callers mask (see `pairing_check`)."""
+    xp, yp = p_aff
+    xq, yq = q_aff
+    batch = jnp.broadcast_shapes(xp.shape[:-1], xq.shape[:-2])
+    xp = jnp.broadcast_to(xp, batch + xp.shape[-1:])
+    yp = jnp.broadcast_to(yp, batch + yp.shape[-1:])
+    xq = jnp.broadcast_to(xq, batch + xq.shape[-2:])
+    yq = jnp.broadcast_to(yq, batch + yq.shape[-2:])
+    xp_neg = fp.neg(xp)
+
+    t0 = g2.from_affine(xq, yq)
+    f0 = fp12.one(batch)
+
+    def step(carry, bit):
+        t, f = carry
+        l0, l1, l2 = _line_dbl(t, xp_neg, yp)
+        f = fp12.mul_by_line(fp12.square(f), l0, l1, l2)
+        t = g2.double(t)
+
+        def with_add(operand):
+            t_in, f_in = operand
+            a0, a1, a2 = _line_add(t_in, (xq, yq), xp_neg, yp)
+            f_out = fp12.mul_by_line(f_in, a0, a1, a2)
+            t_out = g2.add_mixed(t_in, (xq, yq))
+            return t_out, f_out
+
+        t, f = lax.cond(bit != 0, with_add, lambda o: o, (t, f))
+        return (t, f), None
+
+    (t_final, f), _ = lax.scan(step, (t0, f0), jnp.asarray(_X_BITS_TAIL))
+    del t_final
+    return fp12.conj(f)
+
+
+def _pow_x_abs(g):
+    """g^|x| via square-and-multiply scan (63 squarings, 5 multiplies behind
+    a scalar-predicate cond)."""
+
+    def step(acc, bit):
+        acc = fp12.square(acc)
+        acc = lax.cond(bit != 0, lambda a: fp12.mul(a, g), lambda a: a, acc)
+        return acc, None
+
+    acc, _ = lax.scan(step, g, jnp.asarray(_X_BITS_TAIL))
+    return acc
+
+
+def _pow_x(g):
+    """g^x, x negative: g^|x| then conjugate (cyclotomic inverse)."""
+    return fp12.conj(_pow_x_abs(g))
+
+
+def final_exponentiation(f):
+    """Easy part then HHT hard part — mirrors oracle final_exponentiation
+    (computes pairing³; preserves == 1 checks since 3 ∤ r)."""
+    f = fp12.mul(fp12.conj(f), fp12.inv(f))  # f^(p⁶−1)
+    f = fp12.mul(fp12.frobenius(f, 2), f)  # ^(p²+1): cyclotomic now
+
+    def pow_x_minus_1(g):
+        return fp12.mul(_pow_x(g), fp12.conj(g))
+
+    a = pow_x_minus_1(pow_x_minus_1(f))
+    b = fp12.mul(_pow_x(a), fp12.frobenius(a, 1))
+    c = fp12.mul(
+        fp12.mul(_pow_x(_pow_x(b)), fp12.frobenius(b, 2)), fp12.conj(b)
+    )
+    f3 = fp12.mul(fp12.mul(f, f), f)
+    return fp12.mul(c, f3)
+
+
+def pairing(p_aff, q_aff):
+    return final_exponentiation(miller_loop(p_aff, q_aff))
+
+
+def pairing_check(p_affs, q_affs, valid_mask):
+    """Π_i e(P_i, Q_i) == 1 over the batch axis 0 (the multi-pairing
+    verification primitive, oracle: bls/pairing.multi_pairing).
+
+    p_affs = (xp, yp) with leading batch axis; q_affs = (xq, yq) likewise;
+    valid_mask (batch,) bool — False lanes contribute 1 (the e(O, ·) = 1
+    convention for infinity inputs).
+    """
+    fs = miller_loop(p_affs, q_affs)
+    fs = fp12.select(valid_mask, fs, fp12.one(fs.shape[:-4]))
+
+    # log2-depth product reduction over the batch axis (device-friendly).
+    n = fs.shape[0]
+    while n > 1:
+        half = n // 2
+        head = fp12.mul(fs[:half], fs[half : 2 * half])
+        fs = head if n % 2 == 0 else jnp.concatenate([head, fs[2 * half :]], 0)
+        n = fs.shape[0]
+    return fp12.is_one(final_exponentiation(fs[0]))

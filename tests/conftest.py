@@ -7,7 +7,20 @@ exercised against this mesh; the driver's `dryrun_multichip` does the same.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a real TPU
+# tunnel (single chip) — tests must not contend with the bench/driver for it,
+# and a leaked device claim would hang backend init indefinitely.
+# Set LODESTAR_TPU_TEST_PLATFORM=axon to run the suite on real hardware.
+_platform = os.environ.get("LODESTAR_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+
+# A site hook may have imported jax at interpreter start, latching the
+# ambient JAX_PLATFORMS (e.g. a tunnel-backed TPU plugin whose lazy client
+# creation blocks on a single-device claim). Updating the live config — not
+# just the env var — makes backends() initialize only the selected platform.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

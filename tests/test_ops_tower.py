@@ -1,0 +1,133 @@
+"""Differential tests: device field tower (ops/fp2,fp6,fp12) vs CPU oracle.
+
+Strategy mirrors the reference's use of known-answer + randomized checks for
+blst (SURVEY.md §4.2): random elements from the oracle, push through the
+device op (batched, jitted), pull back, compare exactly. All consensus math
+must be bit-exact (SURVEY.md §7 hard part #8).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls.fields import P, Fq, Fq2, Fq6, Fq12
+from lodestar_tpu.ops import fp2 as jfp2
+from lodestar_tpu.ops import fp6 as jfp6
+from lodestar_tpu.ops import fp12 as jfp12
+from lodestar_tpu.ops.io_host import (
+    fq2_to_limbs,
+    fq6_to_limbs,
+    fq12_to_limbs,
+    limbs_to_fq2,
+    limbs_to_fq6,
+    limbs_to_fq12,
+)
+
+rng = random.Random(0xF2F6F12)
+
+
+def rand_fq2() -> Fq2:
+    return Fq2(Fq(rng.randrange(P)), Fq(rng.randrange(P)))
+
+
+def rand_fq6() -> Fq6:
+    return Fq6(rand_fq2(), rand_fq2(), rand_fq2())
+
+
+def rand_fq12() -> Fq12:
+    return Fq12(rand_fq6(), rand_fq6())
+
+
+BATCH = 4
+
+
+def _batch(maker, to_limbs, n=BATCH):
+    vals = [maker() for _ in range(n)]
+    return vals, np.stack([to_limbs(v) for v in vals])
+
+
+class TestFp2:
+    def test_mul_square_inv(self):
+        avals, a = _batch(rand_fq2, fq2_to_limbs)
+        bvals, b = _batch(rand_fq2, fq2_to_limbs)
+        got_mul = jax.jit(jfp2.mul)(a, b)
+        got_sq = jax.jit(jfp2.square)(a)
+        got_inv = jax.jit(jfp2.inv)(a)
+        got_xi = jax.jit(jfp2.mul_by_xi)(a)
+        for i in range(BATCH):
+            assert limbs_to_fq2(got_mul[i]) == avals[i] * bvals[i]
+            assert limbs_to_fq2(got_sq[i]) == avals[i].square()
+            assert limbs_to_fq2(got_inv[i]) == avals[i].inverse()
+            assert limbs_to_fq2(got_xi[i]) == avals[i] * Fq2.from_ints(1, 1)
+
+    def test_add_sub_conj(self):
+        avals, a = _batch(rand_fq2, fq2_to_limbs)
+        bvals, b = _batch(rand_fq2, fq2_to_limbs)
+        got_add = jax.jit(jfp2.add)(a, b)
+        got_sub = jax.jit(jfp2.sub)(a, b)
+        got_conj = jax.jit(jfp2.conj)(a)
+        for i in range(BATCH):
+            assert limbs_to_fq2(got_add[i]) == avals[i] + bvals[i]
+            assert limbs_to_fq2(got_sub[i]) == avals[i] - bvals[i]
+            assert limbs_to_fq2(got_conj[i]) == avals[i].conjugate()
+
+
+class TestFp6:
+    def test_mul_inv_mul_by_v(self):
+        avals, a = _batch(rand_fq6, fq6_to_limbs)
+        bvals, b = _batch(rand_fq6, fq6_to_limbs)
+        got_mul = jax.jit(jfp6.mul)(a, b)
+        got_v = jax.jit(jfp6.mul_by_v)(a)
+        got_inv = jax.jit(jfp6.inv)(a)
+        for i in range(BATCH):
+            assert limbs_to_fq6(got_mul[i]) == avals[i] * bvals[i]
+            assert limbs_to_fq6(got_v[i]) == avals[i].mul_by_v()
+            assert limbs_to_fq6(got_inv[i]) == avals[i].inverse()
+
+
+class TestFp12:
+    def test_mul_square(self):
+        avals, a = _batch(rand_fq12, fq12_to_limbs)
+        bvals, b = _batch(rand_fq12, fq12_to_limbs)
+        got_mul = jax.jit(jfp12.mul)(a, b)
+        got_sq = jax.jit(jfp12.square)(a)
+        for i in range(BATCH):
+            assert limbs_to_fq12(got_mul[i]) == avals[i] * bvals[i]
+            assert limbs_to_fq12(got_sq[i]) == avals[i].square()
+
+    def test_inv_conj(self):
+        avals, a = _batch(rand_fq12, fq12_to_limbs)
+        got_inv = jax.jit(jfp12.inv)(a)
+        got_conj = jax.jit(jfp12.conj)(a)
+        for i in range(BATCH):
+            assert limbs_to_fq12(got_inv[i]) == avals[i].inverse()
+            assert limbs_to_fq12(got_conj[i]) == avals[i].conjugate()
+
+    @pytest.mark.parametrize("power", [1, 2, 3])
+    def test_frobenius(self, power):
+        avals, a = _batch(rand_fq12, fq12_to_limbs)
+        got = jax.jit(jfp12.frobenius, static_argnums=1)(a, power)
+        for i in range(BATCH):
+            assert limbs_to_fq12(got[i]) == avals[i].frobenius(power)
+
+    def test_mul_by_line(self):
+        avals, a = _batch(rand_fq12, fq12_to_limbs)
+        l0v, l0 = _batch(rand_fq2, fq2_to_limbs)
+        l1v, l1 = _batch(rand_fq2, fq2_to_limbs)
+        l2v, l2 = _batch(rand_fq2, fq2_to_limbs)
+        got = jax.jit(jfp12.mul_by_line)(a, l0, l1, l2)
+        for i in range(BATCH):
+            # line = l0 + l1·w² + l2·w³ as a full Fq12 element
+            line = Fq12(
+                Fq6(l0v[i], l1v[i], Fq2.zero()),
+                Fq6(Fq2.zero(), l2v[i], Fq2.zero()),
+            )
+            assert limbs_to_fq12(got[i]) == avals[i] * line
+
+    def test_one_is_one(self):
+        one = jfp12.one((2,))
+        assert bool(jfp12.is_one(one).all())
+        _, a = _batch(rand_fq12, fq12_to_limbs, n=2)
+        assert not bool(jfp12.is_one(a).any())
