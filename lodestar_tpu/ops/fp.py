@@ -164,6 +164,11 @@ def one_mont(batch: tuple = ()) -> jnp.ndarray:
     return jnp.broadcast_to(_ONE_MONT, batch + (N_LIMBS,))
 
 
+# Uniform field-module interface (CurveOps is generic over fp/fp2): "one" is
+# the multiplicative identity in the working (Montgomery) representation.
+one = one_mont
+
+
 def _exp_bits(e: int) -> np.ndarray:
     """MSB-first bit array of a positive exponent (static)."""
     bits = bin(e)[2:]

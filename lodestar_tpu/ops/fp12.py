@@ -169,6 +169,23 @@ def frobenius(a, power: int):
     return _from_w(d)
 
 
+def product_tree(fs):
+    """log2-depth product over axis 0 (length static; empty → 1).
+
+    Shared by pairing.pairing_check and the batch verifier — the reduction
+    shape matters for device parallelism (sequential fold would serialize
+    the whole batch)."""
+    n = fs.shape[0]
+    if n == 0:
+        return one(fs.shape[1:-4])
+    while n > 1:
+        half = n // 2
+        head = mul(fs[:half], fs[half : 2 * half])
+        fs = head if n % 2 == 0 else jnp.concatenate([head, fs[2 * half :]], 0)
+        n = fs.shape[0]
+    return fs[0]
+
+
 def is_one(a):
     return eq(a, one(a.shape[:-4]))
 

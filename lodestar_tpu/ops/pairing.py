@@ -38,31 +38,42 @@ X_ABS = abs(X_PARAM)
 _X_BITS_TAIL = np.array([int(b) for b in bin(X_ABS)[3:]], dtype=np.int32)
 
 
-def _line_dbl(t, xp_neg, yp):
+def _line_dbl(t, xp_neg, yp, zp):
     """Tangent-line coefficients at T (projective, on twist), evaluated at
     P = (xp, yp) ∈ G1 affine, line scaled by 2YZ²·w³:
         l0 = 3X³ − 2Y²Z,  l1 = 3X²Z·(−xp),  l2 = 2YZ²·yp
-    (l = l0 + l1·w² + l2·w³). Expects xp_neg = −xp precomputed."""
+    (l = l0 + l1·w² + l2·w³). Expects xp_neg = −xp precomputed.
+
+    When P is projective (zp is not None, xp_neg = −Xp, yp = Yp), the whole
+    line is additionally scaled by Zp ∈ Fp — a subfield factor annihilated
+    by the final exponentiation (x^(p⁶−1) = 1 for x ∈ Fp), so projective-P
+    pairings cost one extra Fp2·Fp mul per step instead of a per-lane field
+    inversion. This is what lets the batch verifier feed r_i·pk_i straight
+    out of the scalar-mul scan."""
     x, y, z = t
     xx = fp2.mul(x, x)
     yy = fp2.mul(y, y)
-    zz = fp2.mul(z, z)
     three_xx = fp2.add(fp2.add(xx, xx), xx)
     l0 = fp2.sub(fp2.mul(three_xx, x), fp2.double(fp2.mul(yy, z)))
+    if zp is not None:
+        l0 = fp2.mul_fp(l0, zp)
     l1 = fp2.mul_fp(fp2.mul(three_xx, z), xp_neg)
     l2 = fp2.mul_fp(fp2.double(fp2.mul(fp2.mul(y, z), z)), yp)
     return l0, l1, l2
 
 
-def _line_add(t, q_aff, xp_neg, yp):
+def _line_add(t, q_aff, xp_neg, yp, zp):
     """Chord-line coefficients through T and affine Q, evaluated at P,
     scaled by H·w³ with θ = Y − yq·Z, H = X − xq·Z:
-        l0 = θ·xq − yq·H,  l1 = θ·(−xp),  l2 = H·yp."""
+        l0 = θ·xq − yq·H,  l1 = θ·(−xp),  l2 = H·yp.
+    Projective P handled as in `_line_dbl` (l0 scaled by Zp)."""
     x, y, z = t
     xq, yq = q_aff
     theta = fp2.sub(y, fp2.mul(yq, z))
     h = fp2.sub(x, fp2.mul(xq, z))
     l0 = fp2.sub(fp2.mul(theta, xq), fp2.mul(yq, h))
+    if zp is not None:
+        l0 = fp2.mul_fp(l0, zp)
     l1 = fp2.mul_fp(theta, xp_neg)
     l2 = fp2.mul_fp(h, yp)
     return l0, l1, l2
@@ -72,11 +83,31 @@ def miller_loop(p_aff, q_aff):
     """f = conj(f_{|x|,Q}(P)) for P ∈ G1 affine (xp, yp limbs), Q ∈ G2
     affine ((2,32)-limb coords). Batched over leading axes; does NOT handle
     infinity — callers mask (see `pairing_check`)."""
-    xp, yp = p_aff
-    xq, yq = q_aff
+    return _miller_loop_impl(p_aff[0], p_aff[1], None, q_aff[0], q_aff[1])
+
+
+def miller_loop_projective(p_proj, q_aff):
+    """Same as `miller_loop` but P = (Xp, Yp, Zp) homogeneous projective —
+    equal post-final-exp, up to the Zp^k subfield scale (see `_line_dbl`).
+    Zp = 0 lanes produce garbage; callers mask them."""
+    return _miller_loop_impl(p_proj[0], p_proj[1], p_proj[2], q_aff[0], q_aff[1])
+
+
+def _miller_loop_impl(xp, yp, zp, xq, yq):
     batch = jnp.broadcast_shapes(xp.shape[:-1], xq.shape[:-2])
+    # Axon-backend workaround: rank-4 (unbatched) fp12 chains miscompile on
+    # the experimental TPU platform (observed: final_exponentiation gives
+    # different results scalar vs batched on identical inputs, 2026-07).
+    # A unit batch axis costs nothing and keeps every deep chain batched.
+    if batch == ():
+        out = _miller_loop_impl(
+            xp[None], yp[None], None if zp is None else zp[None], xq[None], yq[None]
+        )
+        return out[0]
     xp = jnp.broadcast_to(xp, batch + xp.shape[-1:])
     yp = jnp.broadcast_to(yp, batch + yp.shape[-1:])
+    if zp is not None:
+        zp = jnp.broadcast_to(zp, batch + zp.shape[-1:])
     xq = jnp.broadcast_to(xq, batch + xq.shape[-2:])
     yq = jnp.broadcast_to(yq, batch + yq.shape[-2:])
     xp_neg = fp.neg(xp)
@@ -86,13 +117,13 @@ def miller_loop(p_aff, q_aff):
 
     def step(carry, bit):
         t, f = carry
-        l0, l1, l2 = _line_dbl(t, xp_neg, yp)
+        l0, l1, l2 = _line_dbl(t, xp_neg, yp, zp)
         f = fp12.mul_by_line(fp12.square(f), l0, l1, l2)
         t = g2.double(t)
 
         def with_add(operand):
             t_in, f_in = operand
-            a0, a1, a2 = _line_add(t_in, (xq, yq), xp_neg, yp)
+            a0, a1, a2 = _line_add(t_in, (xq, yq), xp_neg, yp, zp)
             f_out = fp12.mul_by_line(f_in, a0, a1, a2)
             t_out = g2.add_mixed(t_in, (xq, yq))
             return t_out, f_out
@@ -126,6 +157,9 @@ def _pow_x(g):
 def final_exponentiation(f):
     """Easy part then HHT hard part — mirrors oracle final_exponentiation
     (computes pairing³; preserves == 1 checks since 3 ∤ r)."""
+    if f.ndim == 4:
+        # unit-batch wrapper: see the axon-backend note in _miller_loop_impl
+        return final_exponentiation(f[None])[0]
     f = fp12.mul(fp12.conj(f), fp12.inv(f))  # f^(p⁶−1)
     f = fp12.mul(fp12.frobenius(f, 2), f)  # ^(p²+1): cyclotomic now
 
@@ -153,14 +187,8 @@ def pairing_check(p_affs, q_affs, valid_mask):
     valid_mask (batch,) bool — False lanes contribute 1 (the e(O, ·) = 1
     convention for infinity inputs).
     """
+    if p_affs[0].shape[0] == 0:
+        return jnp.asarray(True)  # empty product == 1 (vacuous truth)
     fs = miller_loop(p_affs, q_affs)
     fs = fp12.select(valid_mask, fs, fp12.one(fs.shape[:-4]))
-
-    # log2-depth product reduction over the batch axis (device-friendly).
-    n = fs.shape[0]
-    while n > 1:
-        half = n // 2
-        head = fp12.mul(fs[:half], fs[half : 2 * half])
-        fs = head if n % 2 == 0 else jnp.concatenate([head, fs[2 * half :]], 0)
-        n = fs.shape[0]
-    return fp12.is_one(final_exponentiation(fs[0]))
+    return fp12.is_one(final_exponentiation(fp12.product_tree(fs)))

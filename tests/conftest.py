@@ -21,6 +21,13 @@ os.environ["JAX_PLATFORMS"] = _platform
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+# Persistent compilation cache: the pairing/verifier kernels are deep
+# (Miller-loop scans + final-exponentiation chains) and take minutes to
+# compile on the CPU backend; caching makes repeat suite runs cheap.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,123 @@
+"""Differential tests: device pairing (ops/pairing.py) vs CPU oracle.
+
+The device Miller loop scales its line functions by w³ and by Fp/Fp2
+denominators — factors annihilated by the final exponentiation — so raw
+Miller outputs are NOT comparable to the oracle; only post-final-exp values
+are. `final_exponentiation` itself is the same function in both tiers
+(HHT hard part computing pairing³) and is compared bit-for-bit.
+
+Everything runs under jit: the eager path dispatches tens of thousands of
+tiny ops and is orders of magnitude slower even on CPU.
+"""
+
+import jax
+import numpy as np
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.bls import curve as oc
+from lodestar_tpu.bls import pairing as op
+from lodestar_tpu.bls.hash_to_curve import hash_to_g2
+from lodestar_tpu.ops import fp
+from lodestar_tpu.ops import pairing as dp
+from lodestar_tpu.ops.io_host import (
+    fq12_to_limbs,
+    g1_affine_to_limbs,
+    g2_affine_to_limbs,
+    limbs_to_fq12,
+)
+from lodestar_tpu.ops.points import G1_GEN_X, G1_GEN_Y
+
+RNG = np.random.default_rng(99)
+
+_pairing_jit = jax.jit(lambda p, q: dp.pairing(p, q))
+_finalexp_jit = jax.jit(dp.final_exponentiation)
+_check2_jit = jax.jit(dp.pairing_check)
+
+
+def _rand_g1():
+    return oc.PointG1.generator() * int(RNG.integers(2, 2**62))
+
+
+def _rand_g2():
+    return oc.PointG2.generator() * int(RNG.integers(2, 2**62))
+
+
+def _aff(p, g2=False):
+    x, y, _ = (g2_affine_to_limbs if g2 else g1_affine_to_limbs)(p)
+    return np.asarray(x), np.asarray(y)
+
+
+def test_final_exponentiation_matches_oracle():
+    p, q = _rand_g1(), _rand_g2()
+    f = op.miller_loop(p, q)
+    got = limbs_to_fq12(np.asarray(_finalexp_jit(fq12_to_limbs(f))))
+    assert got == op.final_exponentiation(f)
+
+
+def test_pairing_matches_oracle():
+    p, q = _rand_g1(), _rand_g2()
+    got = limbs_to_fq12(np.asarray(_pairing_jit(_aff(p), _aff(q, g2=True))))
+    assert got == op.pairing(p, q)
+
+
+def test_pairing_bilinearity_on_device():
+    # e(aP, Q) == e(P, aQ) — both sides computed wholly on device. Compare
+    # with fp12.eq (canonicalizing): raw limb arrays are NOT unique under
+    # lazy reduction (each element has representations x and x+p).
+    from lodestar_tpu.ops import fp12
+
+    p, q = _rand_g1(), _rand_g2()
+    a = 7
+    lhs = _pairing_jit(_aff(p * a), _aff(q, g2=True))
+    rhs = _pairing_jit(_aff(p), _aff(q * a, g2=True))
+    assert bool(jax.jit(fp12.eq)(lhs, rhs))
+
+
+def _neg_g1_aff():
+    return np.asarray(G1_GEN_X), np.asarray(jax.jit(fp.neg)(G1_GEN_Y))
+
+
+def test_pairing_check_signature_equation():
+    # e(pk, H(m)) · e(−g1, sig) == 1 for a real BLS signature, batched lanes.
+    sk = bls.interop_secret_key(0)
+    pk = sk.to_public_key()
+    msg = b"\x42" * 32
+    sig = sk.sign(msg)
+    h = hash_to_g2(msg)
+
+    neg_g1 = _neg_g1_aff()
+    pk_aff = _aff(pk.point)
+    h_aff = _aff(h, g2=True)
+    sig_aff = _aff(sig.point, g2=True)
+
+    xs = np.stack([pk_aff[0], neg_g1[0]])
+    ys = np.stack([pk_aff[1], neg_g1[1]])
+    qx = np.stack([h_aff[0], sig_aff[0]])
+    qy = np.stack([h_aff[1], sig_aff[1]])
+    mask = np.array([True, True])
+    assert bool(_check2_jit((xs, ys), (qx, qy), mask))
+
+    # wrong message must fail
+    h_bad = _aff(hash_to_g2(b"\x43" * 32), g2=True)
+    qx_bad = np.stack([h_bad[0], sig_aff[0]])
+    qy_bad = np.stack([h_bad[1], sig_aff[1]])
+    assert not bool(_check2_jit((xs, ys), (qx_bad, qy_bad), mask))
+
+
+def test_pairing_check_masked_lane_is_identity():
+    # A masked-out (padding) lane must not affect the product.
+    garbage_p, garbage_q = _aff(_rand_g1()), _aff(_rand_g2(), g2=True)
+    sk = bls.interop_secret_key(3)
+    pk = sk.to_public_key()
+    msg = b"\x07" * 32
+    sig = sk.sign(msg)
+    neg_g1 = _neg_g1_aff()
+    h_aff = _aff(hash_to_g2(msg), g2=True)
+    sig_aff = _aff(sig.point, g2=True)
+
+    xs = np.stack([_aff(pk.point)[0], neg_g1[0], garbage_p[0]])
+    ys = np.stack([_aff(pk.point)[1], neg_g1[1], garbage_p[1]])
+    qx = np.stack([h_aff[0], sig_aff[0], garbage_q[0]])
+    qy = np.stack([h_aff[1], sig_aff[1], garbage_q[1]])
+    mask = np.array([True, True, False])
+    assert bool(_check2_jit((xs, ys), (qx, qy), mask))
