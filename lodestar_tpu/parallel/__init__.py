@@ -1,0 +1,10 @@
+"""TPU parallel tier: batched + mesh-sharded BLS verification kernels.
+
+This package replaces the reference's worker-thread pool
+(`beacon-node/src/chain/bls/multithread/` — N CPU threads, 128 sets/job)
+with single-dispatch XLA kernels: `verifier` is the single-device batched
+path, `sharded` shards the same math over a `jax.sharding.Mesh` with ICI
+collectives.
+"""
+
+from .verifier import BatchVerifier, TpuBlsVerifier  # noqa: F401

@@ -1,0 +1,247 @@
+"""Batched BLS signature-set verification on device.
+
+The device analog of blst's `verifyMultipleSignatures` as consumed by the
+reference's `BlsMultiThreadWorkerPool` (`chain/bls/multithread/index.ts:98`,
+`maybeBatch.ts:16-27` per SURVEY.md §2.2): verify N signature sets with one
+random-linear-combination pairing equation
+
+    Π_i e(r_i·pk_i, H(m_i)) · e(−g1, Σ_i r_i·sig_i) == 1
+
+where r_i are independent nonzero 64-bit scalars. Where the reference
+chunks sets across worker threads, here the whole batch is ONE XLA
+dispatch: scalar muls, N+1 Miller loops, a log-depth Fp12 product and a
+single shared final exponentiation, all vmapped over the batch axis.
+
+Design notes (TPU-first):
+- Fixed batch buckets (powers of two) keep shapes static — one compile per
+  bucket, reused forever. Padding lanes are masked to the Fp12 identity.
+- r_i·pk_i stays projective out of the scalar-mul scan; the Miller loop
+  accepts projective P by scaling lines with Zp ∈ Fp (annihilated by the
+  final exponentiation) — no per-lane field inversion anywhere. The only
+  inversion in the kernel is ONE Fp2 inv for the aggregated signature.
+- The per-set retry path of the reference (`multithread/worker.ts:55-95`:
+  batch fails → verify each set alone) is `verify_individual`: one batched
+  dispatch computing every per-set verdict, not N round-trips.
+
+Host-side preprocessing (deserialization, subgroup checks, hash-to-curve)
+currently runs through the CPU oracle; moving it to C++/device SSWU is the
+next tier.
+"""
+
+from __future__ import annotations
+
+import secrets
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bls import api as bls_api
+from ..bls.hash_to_curve import hash_to_g2
+from ..ops import fp, fp2, fp12
+from ..ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
+from ..ops.pairing import final_exponentiation, miller_loop, miller_loop_projective
+from ..ops.points import G1_GEN_X, G1_GEN_Y, g1, g2
+
+N_LIMBS = 32
+R_BITS = 64  # random-coefficient width (matches blst's 64-bit rand scaling)
+
+__all__ = ["BatchVerifier", "TpuBlsVerifier", "SetArrays"]
+
+
+_fp12_product_tree = fp12.product_tree
+
+
+def _g2_sum_tree(ps):
+    """log2-depth complete-add reduction of G2 projective points over axis 0."""
+    x, y, z = ps
+    n = x.shape[0]
+    while n > 1:
+        half = n // 2
+        a = (x[:half], y[:half], z[:half])
+        b = (x[half : 2 * half], y[half : 2 * half], z[half : 2 * half])
+        hx, hy, hz = g2.add(a, b)
+        if n % 2 != 0:
+            hx = jnp.concatenate([hx, x[2 * half :]], 0)
+            hy = jnp.concatenate([hy, y[2 * half :]], 0)
+            hz = jnp.concatenate([hz, z[2 * half :]], 0)
+        x, y, z = hx, hy, hz
+        n = x.shape[0]
+    return x[0], y[0], z[0]
+
+
+def batch_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
+    """All-or-nothing batch verification; shapes (N, …) static.
+
+    pk_*  (N, 32)     G1 affine Montgomery limbs (pre-aggregated pubkeys)
+    msg_* (N, 2, 32)  G2 affine limbs of H(m_i)
+    sig_* (N, 2, 32)  G2 affine limbs of signatures
+    r_bits (N, 64)    random coefficients, MSB-first bits
+    valid (N,) bool   padding mask — False lanes are ignored
+    Returns scalar bool.
+    """
+    n = pk_x.shape[0]
+    # r_i·pk_i (G1, projective out of the scan — no inversion)
+    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+    # Σ r_i·sig_i (G2): per-lane scalar mul, mask padding to infinity, tree sum
+    rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
+    rsig = g2.select(valid, rsig, g2.infinity((n,)))
+    s = _g2_sum_tree(rsig)
+    s_inf = g2.is_infinity(s)
+    s_aff = g2.to_affine(s)  # the kernel's single inversion (garbage if s_inf)
+
+    # Pair lanes: N (r_i·pk_i, H(m_i)) plus one (−g1, S)
+    xs = jnp.concatenate([rpk[0], G1_GEN_X[None]], 0)
+    ys = jnp.concatenate([rpk[1], fp.neg(G1_GEN_Y)[None]], 0)
+    zs = jnp.concatenate([rpk[2], fp.one((1,))], 0)
+    qx = jnp.concatenate([msg_x, s_aff[0][None]], 0)
+    qy = jnp.concatenate([msg_y, s_aff[1][None]], 0)
+    lane_ok = jnp.concatenate([valid, ~s_inf[None]], 0)
+
+    fs = miller_loop_projective((xs, ys, zs), (qx, qy))
+    fs = fp12.select(lane_ok, fs, fp12.one((n + 1,)))
+    return fp12.is_one(final_exponentiation(_fp12_product_tree(fs)))
+
+
+def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
+    """Per-set verdicts in one dispatch: e(pk_i, H(m_i))·e(−g1, sig_i) == 1.
+
+    The device replacement for the reference's retry-individually fallback
+    (`multithread/worker.ts:55-95`) — instead of N sequential re-verifies,
+    2N Miller loops and N final exponentiations run batched. Returns
+    (N,) bool; padding lanes report False.
+    """
+    n = pk_x.shape[0]
+    neg_gy = fp.neg(G1_GEN_Y)
+    xs = jnp.concatenate([pk_x, jnp.broadcast_to(G1_GEN_X, (n, N_LIMBS))], 0)
+    ys = jnp.concatenate([pk_y, jnp.broadcast_to(neg_gy, (n, N_LIMBS))], 0)
+    qx = jnp.concatenate([msg_x, sig_x], 0)
+    qy = jnp.concatenate([msg_y, sig_y], 0)
+    fs = miller_loop((xs, ys), (qx, qy))
+    prod = fp12.mul(fs[:n], fs[n:])
+    return fp12.is_one(final_exponentiation(prod)) & valid
+
+
+class SetArrays:
+    """Host-marshalled signature sets, padded to a fixed lane count."""
+
+    __slots__ = ("pk_x", "pk_y", "msg_x", "msg_y", "sig_x", "sig_y", "valid", "n")
+
+    def __init__(self, lanes: int):
+        self.pk_x = np.zeros((lanes, N_LIMBS), np.int32)
+        self.pk_y = np.zeros((lanes, N_LIMBS), np.int32)
+        self.msg_x = np.zeros((lanes, 2, N_LIMBS), np.int32)
+        self.msg_y = np.zeros((lanes, 2, N_LIMBS), np.int32)
+        self.sig_x = np.zeros((lanes, 2, N_LIMBS), np.int32)
+        self.sig_y = np.zeros((lanes, 2, N_LIMBS), np.int32)
+        self.valid = np.zeros((lanes,), bool)
+        self.n = 0
+
+
+def _rand_bits(lanes: int, rng) -> np.ndarray:
+    """(lanes, 64) nonzero random scalar bits, MSB first."""
+    out = np.zeros((lanes, R_BITS), np.int32)
+    for i in range(lanes):
+        r = 0
+        while r == 0:
+            r = rng() & ((1 << R_BITS) - 1)
+        out[i] = [(r >> (R_BITS - 1 - j)) & 1 for j in range(R_BITS)]
+    return out
+
+
+class BatchVerifier:
+    """Shape-bucketed jitted kernels. One compile per bucket size, cached."""
+
+    def __init__(self, buckets: tuple[int, ...] = (4, 16, 64, 128)):
+        self.buckets = tuple(sorted(buckets))
+        self._batch = jax.jit(batch_verify_kernel)
+        self._individual = jax.jit(individual_verify_kernel)
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def verify_batch(self, arrs: SetArrays, r_bits: np.ndarray):
+        return self._batch(
+            arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+            arrs.sig_x, arrs.sig_y, r_bits, arrs.valid,
+        )
+
+    def verify_individual(self, arrs: SetArrays):
+        return self._individual(
+            arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+            arrs.sig_x, arrs.sig_y, arrs.valid,
+        )
+
+
+class TpuBlsVerifier:
+    """`IBlsVerifier`-shaped host API over the device kernels
+    (reference: `chain/bls/interface.ts:20-46`).
+
+    verify_signature_sets(sets) — all-or-nothing batch verdict.
+    verify_signature_sets_individual(sets) — per-set verdicts (retry path).
+
+    Semantics match the reference/eth2: infinity pubkeys or signatures,
+    malformed encodings, or failed subgroup checks → False (without
+    raising), exactly like `maybeBatch.ts` catching blst errors.
+    """
+
+    def __init__(self, buckets: tuple[int, ...] = (4, 16, 64, 128), rng=None):
+        self.kernels = BatchVerifier(buckets)
+        self._rng = rng if rng is not None else (lambda: secrets.randbits(R_BITS))
+
+    # -- host marshalling ---------------------------------------------------
+
+    def _marshal(self, sets) -> SetArrays | None:
+        """Build padded device arrays; None if any set is invalid up front."""
+        if not sets:
+            return None
+        lanes = self.kernels.bucket_for(len(sets))
+        if len(sets) > lanes:
+            return None  # caller must chunk (service layer's job)
+        arrs = SetArrays(lanes)
+        for i, s in enumerate(sets):
+            if s.pubkey.point.is_infinity():
+                return None
+            try:
+                sig = bls_api.Signature.from_bytes(s.signature).point
+            except (bls_api.BlsError, ValueError):
+                return None
+            if sig.is_infinity():
+                return None
+            arrs.pk_x[i], arrs.pk_y[i], _ = g1_affine_to_limbs(s.pubkey.point)
+            h = hash_to_g2(s.message)
+            arrs.msg_x[i], arrs.msg_y[i], _ = g2_affine_to_limbs(h)
+            arrs.sig_x[i], arrs.sig_y[i], _ = g2_affine_to_limbs(sig)
+            arrs.valid[i] = True
+        arrs.n = len(sets)
+        return arrs
+
+    # -- public API ---------------------------------------------------------
+
+    def verify_signature_sets(self, sets) -> bool:
+        arrs = self._marshal(sets)
+        if arrs is None:
+            return False
+        r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
+        return bool(self.kernels.verify_batch(arrs, r_bits))
+
+    def verify_signature_sets_individual(self, sets) -> list[bool]:
+        arrs = self._marshal(sets)
+        if arrs is None:
+            # mirror reference behavior: individually report malformed as False
+            return [self._verify_one(s) for s in sets]
+        out = np.asarray(self.kernels.verify_individual(arrs))
+        return [bool(v) for v in out[: arrs.n]]
+
+    def _verify_one(self, s) -> bool:
+        try:
+            arrs = self._marshal([s])
+        except (bls_api.BlsError, ValueError):
+            return False
+        if arrs is None:
+            return False
+        return bool(np.asarray(self.kernels.verify_individual(arrs))[0])
