@@ -1,0 +1,20 @@
+"""Consensus state transition — the pure core (SURVEY.md §2 `state-transition`).
+
+Architecture: where the reference reaches eth2fastspec-level speed with
+persistent-tree views + epoch caches (`packages/state-transition/src/cache/`),
+this package keeps consensus data in SSZ containers (bit-exact roots) and
+mirrors the hot per-validator columns into flat numpy arrays
+(`FlatValidators`) so epoch processing is vectorized array math — the same
+flat-cache idea, realized as struct-of-arrays instead of object graphs, and
+ready to lift onto device (int arrays are jit/vmap friendly).
+
+All consensus arithmetic is host ints / numpy uint64 — never floats
+(determinism requirement, SURVEY.md §7 hard part 8).
+"""
+
+from .cache import EpochContext, FlatValidators, CachedBeaconState  # noqa: F401
+from .stf import state_transition, process_slots  # noqa: F401
+from .genesis import (  # noqa: F401
+    initialize_beacon_state_from_eth1,
+    interop_genesis_state,
+)

@@ -1,0 +1,235 @@
+"""Epoch-scoped caches + flat validator arrays.
+
+The reference's speed comes from `EpochContext` / `EpochProcess`
+(`state-transition/src/cache/epochContext.ts:80`, `epochProcess.ts:43`):
+shufflings, proposers and flat effective-balance arrays computed once per
+epoch. Here the same role is played by numpy struct-of-arrays — every
+per-validator column is one contiguous uint64 array, so epoch processing
+and committee math are SIMD passes rather than object-graph walks (and
+can be lifted to device arrays wholesale).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+from . import util
+
+U64 = np.uint64
+
+
+class FlatValidators:
+    """Struct-of-arrays mirror of state.validators (+ balances).
+
+    Columns are plain numpy arrays; `sync_to_state` writes mutated columns
+    back into the SSZ containers before any hash_tree_root. Mutations during
+    block/epoch processing go through BOTH (SSZ object is source of truth
+    for roots; arrays are the compute representation)."""
+
+    __slots__ = (
+        "pubkeys", "effective_balance", "slashed",
+        "activation_eligibility_epoch", "activation_epoch",
+        "exit_epoch", "withdrawable_epoch", "balances",
+    )
+
+    def __init__(self, state):
+        vs = state.validators
+        n = len(vs)
+        self.pubkeys = [v.pubkey for v in vs]
+        self.effective_balance = np.array([v.effective_balance for v in vs], U64)
+        self.slashed = np.array([v.slashed for v in vs], bool)
+        self.activation_eligibility_epoch = np.array(
+            [v.activation_eligibility_epoch for v in vs], U64
+        )
+        self.activation_epoch = np.array([v.activation_epoch for v in vs], U64)
+        self.exit_epoch = np.array([v.exit_epoch for v in vs], U64)
+        self.withdrawable_epoch = np.array([v.withdrawable_epoch for v in vs], U64)
+        self.balances = np.array(state.balances, U64)
+
+    def __len__(self):
+        return len(self.effective_balance)
+
+    def append(self, validator, balance: int):
+        self.pubkeys.append(validator.pubkey)
+        self.effective_balance = np.append(
+            self.effective_balance, U64(validator.effective_balance)
+        )
+        self.slashed = np.append(self.slashed, bool(validator.slashed))
+        self.activation_eligibility_epoch = np.append(
+            self.activation_eligibility_epoch, U64(validator.activation_eligibility_epoch)
+        )
+        self.activation_epoch = np.append(
+            self.activation_epoch, U64(validator.activation_epoch)
+        )
+        self.exit_epoch = np.append(self.exit_epoch, U64(validator.exit_epoch))
+        self.withdrawable_epoch = np.append(
+            self.withdrawable_epoch, U64(validator.withdrawable_epoch)
+        )
+        self.balances = np.append(self.balances, U64(balance))
+
+    def active_indices(self, epoch: int) -> np.ndarray:
+        mask = util.active_mask(self.activation_epoch, self.exit_epoch, epoch)
+        return np.nonzero(mask)[0].astype(np.int64)
+
+    def total_active_balance(self, epoch: int, increment: int) -> int:
+        mask = util.active_mask(self.activation_epoch, self.exit_epoch, epoch)
+        total = int(self.effective_balance[mask].sum())
+        return max(increment, total)
+
+    def sync_to_state(self, state) -> None:
+        """Write mutated columns back into the SSZ containers."""
+        vs = state.validators
+        for i, v in enumerate(vs):
+            v.effective_balance = int(self.effective_balance[i])
+            v.slashed = bool(self.slashed[i])
+            v.activation_eligibility_epoch = int(self.activation_eligibility_epoch[i])
+            v.activation_epoch = int(self.activation_epoch[i])
+            v.exit_epoch = int(self.exit_epoch[i])
+            v.withdrawable_epoch = int(self.withdrawable_epoch[i])
+        state.balances = [int(b) for b in self.balances]
+
+
+@dataclass
+class EpochShuffling:
+    """Active-set shuffling for one epoch (reference: IEpochShuffling in
+    epochContext — activeIndices + committees derived by slicing)."""
+
+    epoch: int
+    active_indices: np.ndarray  # (n_active,) validator indices
+    shuffled: np.ndarray        # permuted active_indices
+    committees_per_slot: int
+
+
+class EpochContext:
+    """Per-epoch derived data: shufflings for prev/current/next, proposer
+    schedule for the current epoch, pubkey→index map
+    (reference: `cache/epochContext.ts`, `pubkeyCache.ts`)."""
+
+    def __init__(self, config, preset):
+        self.config = config
+        self.preset = preset
+        self.pubkey_to_index: dict[bytes, int] = {}
+        self.previous: EpochShuffling | None = None
+        self.current: EpochShuffling | None = None
+        self.next: EpochShuffling | None = None
+        self.proposers: list[int] = []
+        self.current_epoch = -1
+
+    # -- construction --------------------------------------------------------
+
+    def load_state(self, state, flat: FlatValidators):
+        epoch = util.compute_epoch_at_slot(state.slot, self.preset.SLOTS_PER_EPOCH)
+        self.sync_pubkeys(flat)
+        self.current = self._build_shuffling(state, flat, epoch)
+        prev_epoch = max(GENESIS_EPOCH, epoch - 1)
+        self.previous = (
+            self.current if prev_epoch == epoch
+            else self._build_shuffling(state, flat, prev_epoch)
+        )
+        self.next = self._build_shuffling(state, flat, epoch + 1)
+        self.current_epoch = epoch
+        self._compute_proposers(state, flat, epoch)
+
+    def sync_pubkeys(self, flat: FlatValidators):
+        for i in range(len(self.pubkey_to_index), len(flat.pubkeys)):
+            self.pubkey_to_index[bytes(flat.pubkeys[i])] = i
+
+    def _build_shuffling(self, state, flat: FlatValidators, epoch: int):
+        active = flat.active_indices(epoch)
+        seed = util.get_seed(state, epoch, DOMAIN_BEACON_ATTESTER, self.preset)
+        shuffled = util.shuffle_list(active, seed, self.preset.SHUFFLE_ROUND_COUNT)
+        cps = util.get_committee_count_per_slot(len(active), self.preset)
+        return EpochShuffling(epoch, active, shuffled, cps)
+
+    def _compute_proposers(self, state, flat: FlatValidators, epoch: int):
+        seed_base = util.get_seed(state, epoch, DOMAIN_BEACON_PROPOSER, self.preset)
+        from ..ssz.hashing import sha256
+
+        start = util.compute_start_slot_at_epoch(epoch, self.preset.SLOTS_PER_EPOCH)
+        self.proposers = [
+            util.compute_proposer_index(
+                flat.effective_balance,
+                self.current.active_indices,
+                sha256(seed_base + slot.to_bytes(8, "little")),
+                self.preset,
+            )
+            for slot in range(start, start + self.preset.SLOTS_PER_EPOCH)
+        ]
+
+    # -- epoch rotation -------------------------------------------------------
+
+    def rotate_epoch(self, state, flat: FlatValidators):
+        """After `process_epoch`: prev←current, current←next, next rebuilt
+        (reference: `epochContext.afterProcessEpoch` :454)."""
+        epoch = self.current_epoch + 1
+        self.previous = self.current
+        self.current = self.next
+        # current shuffling's committees_per_slot may change if the active
+        # set changed during registry updates — rebuild honestly.
+        self.next = self._build_shuffling(state, flat, epoch + 1)
+        self.current_epoch = epoch
+        self._compute_proposers(state, flat, epoch)
+
+    # -- queries --------------------------------------------------------------
+
+    def _shuffling_at(self, epoch: int) -> EpochShuffling:
+        for sh in (self.previous, self.current, self.next):
+            if sh is not None and sh.epoch == epoch:
+                return sh
+        raise ValueError(f"no shuffling cached for epoch {epoch}")
+
+    def get_committee_count_per_slot(self, epoch: int) -> int:
+        return self._shuffling_at(epoch).committees_per_slot
+
+    def get_beacon_committee(self, slot: int, index: int) -> np.ndarray:
+        epoch = util.compute_epoch_at_slot(slot, self.preset.SLOTS_PER_EPOCH)
+        sh = self._shuffling_at(epoch)
+        return util.compute_committee_slice(
+            sh.shuffled,
+            slot % self.preset.SLOTS_PER_EPOCH,
+            index,
+            sh.committees_per_slot,
+            self.preset.SLOTS_PER_EPOCH,
+        )
+
+    def get_beacon_proposer(self, slot: int) -> int:
+        epoch = util.compute_epoch_at_slot(slot, self.preset.SLOTS_PER_EPOCH)
+        if epoch != self.current_epoch:
+            raise ValueError("proposer requested outside current epoch")
+        return self.proposers[slot % self.preset.SLOTS_PER_EPOCH]
+
+
+class CachedBeaconState:
+    """SSZ state + flat arrays + epoch context, travelling together
+    (reference: `CachedBeaconState*`, `cache/stateCache.ts:112`)."""
+
+    def __init__(self, config, state, preset=None):
+        self.config = config
+        self.preset = preset if preset is not None else config.preset
+        self.state = state
+        self.flat = FlatValidators(state)
+        self.epoch_ctx = EpochContext(config, self.preset)
+        self.epoch_ctx.load_state(state, self.flat)
+
+    @property
+    def slot(self) -> int:
+        return self.state.slot
+
+    @property
+    def current_epoch(self) -> int:
+        return util.compute_epoch_at_slot(self.state.slot, self.preset.SLOTS_PER_EPOCH)
+
+    @property
+    def previous_epoch(self) -> int:
+        return max(GENESIS_EPOCH, self.current_epoch - 1)
+
+    def copy(self) -> "CachedBeaconState":
+        return CachedBeaconState(self.config, self.state.copy(), self.preset)

@@ -1,0 +1,179 @@
+"""Genesis state construction + interop genesis.
+
+Spec `initialize_beacon_state_from_eth1` plus the deterministic interop
+path the reference uses for dev/sim networks
+(`state-transition/src/util/interop.ts`-equivalent roles; genesis builder
+reference: `beacon-node/src/chain/genesis/genesis.ts`).
+"""
+
+from __future__ import annotations
+
+from ..bls.api import SecretKey, interop_secret_key
+from ..config.beacon_config import compute_domain, compute_signing_root
+from ..params import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    DOMAIN_DEPOSIT,
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+)
+from ..ssz.hashing import sha256
+from .block import apply_deposit_data
+
+
+class DepositTree:
+    """Incremental depth-32 merkle tree (the deposit-contract algorithm):
+    append leaves, produce proofs against the current root. Proofs include
+    the trailing length mix-in (depth+1 branch) per the spec layout."""
+
+    def __init__(self, depth: int = DEPOSIT_CONTRACT_TREE_DEPTH):
+        self.depth = depth
+        self.zero_hashes = [b"\x00" * 32]
+        for _ in range(depth):
+            self.zero_hashes.append(
+                sha256(self.zero_hashes[-1] + self.zero_hashes[-1])
+            )
+        self.leaves: list[bytes] = []
+
+    def append(self, leaf: bytes) -> None:
+        self.leaves.append(leaf)
+
+    def root(self) -> bytes:
+        """Root including the uint256-length mix-in (deposit contract
+        `get_deposit_root`)."""
+        node = self._subtree_root()
+        return sha256(node + len(self.leaves).to_bytes(32, "little"))
+
+    def _subtree_root(self) -> bytes:
+        nodes = list(self.leaves)
+        for h in range(self.depth):
+            if len(nodes) % 2 == 1:
+                nodes.append(self.zero_hashes[h])
+            nodes = [sha256(nodes[i] + nodes[i + 1]) for i in range(0, len(nodes), 2)]
+        return nodes[0] if nodes else self.zero_hashes[self.depth]
+
+    def proof(self, index: int) -> list[bytes]:
+        """Branch for leaf `index` against `root()` — depth+1 elements, the
+        last being the length mix-in."""
+        branch: list[bytes] = []
+        nodes = list(self.leaves)
+        idx = index
+        for h in range(self.depth):
+            if len(nodes) % 2 == 1:
+                nodes.append(self.zero_hashes[h])
+            sibling = idx ^ 1
+            branch.append(nodes[sibling] if sibling < len(nodes) else self.zero_hashes[h])
+            nodes = [sha256(nodes[i] + nodes[i + 1]) for i in range(0, len(nodes), 2)]
+            idx //= 2
+        branch.append(len(self.leaves).to_bytes(32, "little"))
+        return branch
+
+
+def initialize_beacon_state_from_eth1(
+    config, types, eth1_block_hash: bytes, eth1_timestamp: int, deposits
+):
+    """Spec initialize_beacon_state_from_eth1 (phase0 types namespace)."""
+    p = config.preset
+    state = types.BeaconState()
+    state.genesis_time = eth1_timestamp + config.GENESIS_DELAY
+    state.fork = types.Fork(
+        previous_version=config.GENESIS_FORK_VERSION,
+        current_version=config.GENESIS_FORK_VERSION,
+        epoch=GENESIS_EPOCH,
+    )
+    state.eth1_data = types.Eth1Data(
+        deposit_root=b"\x00" * 32,
+        deposit_count=len(deposits),
+        block_hash=eth1_block_hash,
+    )
+    body_root = types.BeaconBlockBody().hash_tree_root()
+    state.latest_block_header = types.BeaconBlockHeader(body_root=body_root)
+    state.randao_mixes = [eth1_block_hash] * p.EPOCHS_PER_HISTORICAL_VECTOR
+
+    # process deposits against an incrementally-updated deposit root
+    tree = DepositTree()
+    leaves = [d.data for d in deposits]
+    for i, deposit in enumerate(deposits):
+        tree.append(leaves[i].hash_tree_root())
+        state.eth1_data.deposit_root = tree.root()
+        # genesis deposits: proof verified against the incremental root
+        from .util import is_valid_merkle_branch
+
+        assert is_valid_merkle_branch(
+            leaves[i].hash_tree_root(),
+            list(deposit.proof),
+            DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            i,
+            state.eth1_data.deposit_root,
+        ), f"invalid genesis deposit proof at {i}"
+        state.eth1_deposit_index += 1
+        apply_deposit_data(config, types, state, deposit.data)
+
+    # activate validators with full effective balance
+    for v in state.validators:
+        if v.effective_balance == p.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
+    validators_type = dict(type(state).fields)["validators"]
+    state.genesis_validators_root = validators_type.hash_tree_root(state.validators)
+    return state
+
+
+def is_valid_genesis_state(config, state) -> bool:
+    if state.genesis_time < config.MIN_GENESIS_TIME:
+        return False
+    active = sum(
+        1 for v in state.validators if v.activation_epoch == GENESIS_EPOCH
+    )
+    return active >= config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+
+
+def make_interop_deposits(config, types, n: int, amount: int | None = None):
+    """Deterministic interop deposits: keys via `interop_secret_key(i)`,
+    BLS withdrawal credentials, signed DepositMessages, merkle proofs from
+    the incremental tree."""
+    p = config.preset
+    amount = amount if amount is not None else p.MAX_EFFECTIVE_BALANCE
+    domain = compute_domain(DOMAIN_DEPOSIT, config.GENESIS_FORK_VERSION, b"\x00" * 32)
+    datas = []
+    for i in range(n):
+        sk = interop_secret_key(i)
+        pk = sk.to_public_key().to_bytes()
+        wc = b"\x00" + sha256(pk)[1:]
+        msg = types.DepositMessage(
+            pubkey=pk, withdrawal_credentials=wc, amount=amount
+        )
+        sig = sk.sign(compute_signing_root(msg.hash_tree_root(), domain))
+        datas.append(
+            types.DepositData(
+                pubkey=pk,
+                withdrawal_credentials=wc,
+                amount=amount,
+                signature=sig.to_bytes(),
+            )
+        )
+    # proofs are against the FINAL root only for the last deposit; genesis
+    # processing verifies each against the root-so-far, so build proofs
+    # incrementally.
+    deposits = []
+    tree = DepositTree()
+    for i, data in enumerate(datas):
+        tree.append(data.hash_tree_root())
+    for i, data in enumerate(datas):
+        # proof for leaf i against the tree containing leaves 0..i
+        partial = DepositTree()
+        for d in datas[: i + 1]:
+            partial.append(d.hash_tree_root())
+        deposits.append(types.Deposit(proof=partial.proof(i), data=data))
+    return deposits
+
+
+def interop_genesis_state(config, types, n_validators: int, genesis_time: int = 0):
+    """Dev/sim genesis on interop keys (reference: `dev` command path,
+    `cli/src/cmds/dev` + interop state)."""
+    deposits = make_interop_deposits(config, types, n_validators)
+    state = initialize_beacon_state_from_eth1(
+        config, types, b"\x42" * 32, max(0, genesis_time - config.GENESIS_DELAY), deposits
+    )
+    if genesis_time:
+        state.genesis_time = genesis_time
+    return state

@@ -1,0 +1,139 @@
+"""Extract every signature set from a signed block.
+
+Reference: `state-transition/src/signatureSets/index.ts:24`
+(getBlockSignatureSets) — the producer side of the batch-verification
+pipeline: ~100 sets per mainnet block, fed to the (TPU) batch verifier in
+one dispatch instead of per-op inline verification.
+
+Each set carries a PRE-AGGREGATED pubkey (reference aggregates on the main
+thread — `chain/bls/utils.ts:5`): aggregation is cheap G1 addition; the
+pairing work stays on device.
+"""
+
+from __future__ import annotations
+
+from ..bls import api as bls
+from ..config.beacon_config import compute_signing_root
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_VOLUNTARY_EXIT,
+)
+from . import util
+from .block import get_attesting_indices
+
+
+def _pk(cached, index: int) -> bls.PublicKey:
+    return bls.PublicKey.from_bytes(bytes(cached.flat.pubkeys[index]), validate=False)
+
+
+def block_proposer_signature_set(cached, signed_block) -> bls.SignatureSet:
+    block = signed_block.message
+    domain = cached.config.get_domain(DOMAIN_BEACON_PROPOSER, block.slot)
+    return bls.SignatureSet(
+        pubkey=_pk(cached, block.proposer_index),
+        message=compute_signing_root(block.hash_tree_root(), domain),
+        signature=bytes(signed_block.signature),
+    )
+
+
+def randao_signature_set(cached, block) -> bls.SignatureSet:
+    from .block import _epoch_signing_root
+
+    epoch = util.compute_epoch_at_slot(block.slot, cached.preset.SLOTS_PER_EPOCH)
+    domain = cached.config.get_domain(DOMAIN_RANDAO, block.slot)
+    return bls.SignatureSet(
+        pubkey=_pk(cached, block.proposer_index),
+        message=_epoch_signing_root(epoch, domain),
+        signature=bytes(block.body.randao_reveal),
+    )
+
+
+def indexed_attestation_signature_set(cached, indexed) -> bls.SignatureSet:
+    domain = cached.config.get_domain(
+        DOMAIN_BEACON_ATTESTER,
+        util.compute_start_slot_at_epoch(
+            indexed.data.target.epoch, cached.preset.SLOTS_PER_EPOCH
+        ),
+        indexed.data.target.epoch,
+    )
+    agg = bls.aggregate_pubkeys(
+        [_pk(cached, i) for i in indexed.attesting_indices]
+    )
+    return bls.SignatureSet(
+        pubkey=agg,
+        message=compute_signing_root(indexed.data.hash_tree_root(), domain),
+        signature=bytes(indexed.signature),
+    )
+
+
+def attestation_signature_set(cached, types, attestation) -> bls.SignatureSet:
+    indexed = types.IndexedAttestation(
+        attesting_indices=get_attesting_indices(
+            cached, attestation.data, attestation.aggregation_bits
+        ),
+        data=attestation.data.copy(),
+        signature=bytes(attestation.signature),
+    )
+    return indexed_attestation_signature_set(cached, indexed)
+
+
+def proposer_slashing_signature_sets(cached, op) -> list[bls.SignatureSet]:
+    sets = []
+    for signed in (op.signed_header_1, op.signed_header_2):
+        domain = cached.config.get_domain(
+            DOMAIN_BEACON_PROPOSER, signed.message.slot
+        )
+        sets.append(
+            bls.SignatureSet(
+                pubkey=_pk(cached, signed.message.proposer_index),
+                message=compute_signing_root(signed.message.hash_tree_root(), domain),
+                signature=bytes(signed.signature),
+            )
+        )
+    return sets
+
+
+def attester_slashing_signature_sets(cached, op) -> list[bls.SignatureSet]:
+    return [
+        indexed_attestation_signature_set(cached, indexed)
+        for indexed in (op.attestation_1, op.attestation_2)
+    ]
+
+
+def voluntary_exit_signature_set(cached, signed_exit) -> bls.SignatureSet:
+    msg = signed_exit.message
+    domain = cached.config.get_domain(
+        DOMAIN_VOLUNTARY_EXIT,
+        util.compute_start_slot_at_epoch(msg.epoch, cached.preset.SLOTS_PER_EPOCH),
+        msg.epoch,
+    )
+    return bls.SignatureSet(
+        pubkey=_pk(cached, msg.validator_index),
+        message=compute_signing_root(msg.hash_tree_root(), domain),
+        signature=bytes(signed_exit.signature),
+    )
+
+
+def get_block_signature_sets(
+    cached, types, signed_block, include_proposer: bool = True
+) -> list[bls.SignatureSet]:
+    """All sets for one block (reference getBlockSignatureSets). Deposits
+    are excluded: their proofs/signatures verify inline with their own
+    rules (invalid deposit sigs are skipped, not failed)."""
+    block = signed_block.message
+    body = block.body
+    sets: list[bls.SignatureSet] = []
+    if include_proposer:
+        sets.append(block_proposer_signature_set(cached, signed_block))
+    sets.append(randao_signature_set(cached, block))
+    for op in body.proposer_slashings:
+        sets.extend(proposer_slashing_signature_sets(cached, op))
+    for op in body.attester_slashings:
+        sets.extend(attester_slashing_signature_sets(cached, op))
+    for att in body.attestations:
+        sets.append(attestation_signature_set(cached, types, att))
+    for op in body.voluntary_exits:
+        sets.append(voluntary_exit_signature_set(cached, op))
+    return sets

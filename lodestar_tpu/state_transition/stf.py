@@ -1,0 +1,64 @@
+"""state_transition / process_slots — the pure transition driver.
+
+Reference: `state-transition/src/stateTransition.ts:30,91` — same
+decomposition: per-slot root caching, epoch processing at boundaries,
+block processing, optional post-state root verification.
+"""
+
+from __future__ import annotations
+
+from . import util
+from .block import BlockProcessingError, process_block
+from .epoch import process_epoch
+
+
+def process_slot(cached, types) -> None:
+    state, p = cached.state, cached.preset
+    prev_state_root = state.hash_tree_root()
+    state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
+    if state.latest_block_header.state_root == b"\x00" * 32:
+        state.latest_block_header.state_root = prev_state_root
+    state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = (
+        state.latest_block_header.hash_tree_root()
+    )
+
+
+def process_slots(cached, types, slot: int) -> None:
+    state, p = cached.state, cached.preset
+    if slot <= state.slot:
+        raise BlockProcessingError(
+            f"process_slots target {slot} <= current {state.slot}"
+        )
+    while state.slot < slot:
+        process_slot(cached, types)
+        if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
+            process_epoch(cached, types)
+            cached.flat.sync_to_state(state)
+            state.slot += 1
+            cached.epoch_ctx.rotate_epoch(state, cached.flat)
+        else:
+            state.slot += 1
+
+
+def state_transition(
+    cached,
+    types,
+    signed_block,
+    verify_state_root: bool = True,
+    verify_signatures: bool = True,
+):
+    """Apply a signed block. The block-signature (proposer) check itself is
+    part of the caller's signature-set batch (reference keeps it out of
+    stateTransition too — `verifySignatures` option)."""
+    block = signed_block.message
+    if block.slot > cached.state.slot:
+        process_slots(cached, types, block.slot)
+    process_block(cached, types, block, verify_signatures)
+    cached.flat.sync_to_state(cached.state)
+    if verify_state_root:
+        got = cached.state.hash_tree_root()
+        if got != bytes(block.state_root):
+            raise BlockProcessingError(
+                f"state root mismatch: {got.hex()} != {bytes(block.state_root).hex()}"
+            )
+    return cached

@@ -1,0 +1,225 @@
+"""State-transition tests: shuffle, genesis, sanity slots/blocks, finality.
+
+Models the reference's spec-test categories (sanity, finality — SURVEY.md
+§4.2) as self-contained scenarios on the minimal preset: a 64-validator
+interop genesis driven through 4 epochs of fully-attested blocks must
+justify and finalize; signature sets of produced blocks must verify.
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig, compute_signing_root
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER, DOMAIN_RANDAO
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import (
+    CachedBeaconState,
+    interop_genesis_state,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.state_transition import util
+from lodestar_tpu.state_transition.block import _epoch_signing_root
+from lodestar_tpu.state_transition.genesis import is_valid_genesis_state
+from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
+from lodestar_tpu.types import get_types
+
+N_VALIDATORS = 64
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+
+@pytest.fixture(scope="module")
+def types():
+    return get_types(MINIMAL).phase0
+
+
+@pytest.fixture(scope="module")
+def genesis(types):
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, N_VALIDATORS, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    return config, state
+
+
+def test_shuffle_list_matches_per_index():
+    seed = b"\x5a" * 32
+    n = 100
+    idx = np.arange(n, dtype=np.int64)
+    shuffled = util.shuffle_list(idx, seed, MINIMAL.SHUFFLE_ROUND_COUNT)
+    expected = [
+        util.compute_shuffled_index(i, n, seed, MINIMAL.SHUFFLE_ROUND_COUNT)
+        for i in range(n)
+    ]
+    assert shuffled.tolist() == expected
+    inv = util.unshuffle_list(shuffled, seed, MINIMAL.SHUFFLE_ROUND_COUNT)
+    assert inv.tolist() == idx.tolist()
+
+
+def test_interop_genesis_valid(genesis):
+    config, state = genesis
+    assert is_valid_genesis_state(config, state)
+    assert len(state.validators) == N_VALIDATORS
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert state.balances == [MINIMAL.MAX_EFFECTIVE_BALANCE] * N_VALIDATORS
+
+
+def test_process_slots_across_epoch(genesis, types):
+    config, state = genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, types, SPE + 1)
+    assert cached.state.slot == SPE + 1
+    assert cached.current_epoch == 1
+
+
+# --- mini validator/producer (the test-side analog of the reference's
+# valid-data factories, beacon-node/test/utils/validationData) -------------
+
+
+def _sk(i: int):
+    return bls.interop_secret_key(i)
+
+
+def _block_root_at(state, slot: int) -> bytes:
+    if slot == state.slot:
+        hdr = state.latest_block_header.copy()
+        if hdr.state_root == b"\x00" * 32:
+            hdr.state_root = state.hash_tree_root()
+        return hdr.hash_tree_root()
+    return bytes(state.block_roots[slot % MINIMAL.SLOTS_PER_HISTORICAL_ROOT])
+
+
+def produce_attestations(config, types, cached, head_root: bytes):
+    """Full-participation attestations for the current slot."""
+    state = cached.state
+    slot = state.slot
+    epoch = slot // SPE
+    start = epoch * SPE
+    target_root = head_root if start == slot else _block_root_at(state, start)
+    atts = []
+    domain = config.get_domain(DOMAIN_BEACON_ATTESTER, slot, epoch)
+    for index in range(cached.epoch_ctx.get_committee_count_per_slot(epoch)):
+        committee = cached.epoch_ctx.get_beacon_committee(slot, index)
+        data = types.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint.copy(),
+            target=types.Checkpoint(epoch=epoch, root=target_root),
+        )
+        root = compute_signing_root(data.hash_tree_root(), domain)
+        sigs = [_sk(int(v)).sign(root) for v in committee]
+        atts.append(
+            types.Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=bls.aggregate_signatures(sigs).to_bytes(),
+            )
+        )
+    return atts
+
+
+def produce_block(config, types, cached, slot: int, attestations):
+    pre = cached.copy()
+    if slot > pre.state.slot:
+        process_slots(pre, types, slot)
+    proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+    sk = _sk(proposer)
+    randao_domain = config.get_domain(DOMAIN_RANDAO, slot)
+    body = types.BeaconBlockBody(
+        randao_reveal=sk.sign(
+            _epoch_signing_root(slot // SPE, randao_domain)
+        ).to_bytes(),
+        eth1_data=pre.state.eth1_data.copy(),
+        attestations=attestations,
+    )
+    block = types.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=pre.state.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    # compute post-state root
+    trial = pre.copy()
+    state_transition(
+        trial,
+        types,
+        types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
+        verify_state_root=False,
+        verify_signatures=False,
+    )
+    block.state_root = trial.state.hash_tree_root()
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, slot)
+    sig = sk.sign(compute_signing_root(block.hash_tree_root(), domain))
+    return types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+
+
+@pytest.fixture(scope="module")
+def finality_run(genesis, types):
+    """Drive 4 epochs of fully-attested blocks; collect artifacts."""
+    config, state = genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    pending = []
+    blocks = []
+    for slot in range(1, 4 * SPE + 1):
+        signed = produce_block(config, types, cached, slot, pending)
+        state_transition(
+            cached, types, signed, verify_state_root=True, verify_signatures=False
+        )
+        blocks.append(signed)
+        head_root = signed.message.hash_tree_root()
+        pending = produce_attestations(config, types, cached, head_root)
+    return config, cached, blocks
+
+
+def test_finality_advances(finality_run):
+    _, cached, _ = finality_run
+    assert cached.current_epoch == 4
+    assert cached.state.current_justified_checkpoint.epoch >= 2
+    assert cached.state.finalized_checkpoint.epoch >= 1
+
+
+def test_balances_accrue_rewards(finality_run):
+    _, cached, _ = finality_run
+    # perfect participation, no leak: every validator should be at or above
+    # its starting balance after reward epochs
+    assert min(cached.state.balances) >= MINIMAL.MAX_EFFECTIVE_BALANCE
+
+
+def test_block_signature_sets_verify(finality_run, genesis, types):
+    config, _, blocks = finality_run
+    _, state = genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    # replay to just before the chosen block, then extract + verify its sets
+    target = blocks[SPE]  # first block of epoch 1 (carries attestations)
+    for signed in blocks[: SPE]:
+        state_transition(
+            cached, types, signed, verify_state_root=False, verify_signatures=False
+        )
+    if target.message.slot > cached.state.slot + 1:
+        process_slots(cached, types, target.message.slot)
+    sets = get_block_signature_sets(cached, types, target)
+    assert len(sets) >= 2  # proposer + randao at minimum
+    assert bls.verify_signature_sets(sets)
+
+    # a corrupted proposer signature must fail the batch
+    bad = types.SignedBeaconBlock(
+        message=target.message.copy(), signature=b"\x11" * 96
+    )
+    bad_sets = get_block_signature_sets(cached, types, bad)
+    assert not bls.verify_signature_sets(bad_sets)
+
+
+def test_full_signature_verification_one_block(finality_run, genesis, types):
+    config, _, blocks = finality_run
+    _, state = genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    for signed in blocks[:2]:
+        state_transition(
+            cached, types, signed, verify_state_root=True, verify_signatures=True
+        )
+    assert cached.state.slot == 2
