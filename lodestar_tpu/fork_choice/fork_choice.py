@@ -1,0 +1,206 @@
+"""ForkChoice: LMD-GHOST votes + FFG checkpoints over the proto-array.
+
+Reference behavior: `fork-choice/src/forkChoice/forkChoice.ts` —
+`onBlock` (:294), `onAttestation` (:505), `updateHead` (:184), queued
+attestations for future epochs, equivocation (attester-slashing) handling,
+checkpoint balances. Vote state here is three numpy arrays indexed by
+validator (current root index, next root index, last-update epoch) so
+`compute_deltas` is two bincounts over int arrays
+(reference computeDeltas.ts walks a JS array per validator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .proto_array import ProtoArray, ProtoArrayError
+
+NO_VOTE = -1
+
+
+@dataclass
+class ForkChoiceStore:
+    """FFG bookkeeping (reference IForkChoiceStore, forkChoice/store.ts)."""
+
+    current_slot: int
+    justified_checkpoint: tuple[int, bytes]
+    finalized_checkpoint: tuple[int, bytes]
+    justified_balances: np.ndarray  # effective balances at justified state
+    best_justified: tuple[int, bytes] | None = None
+    unrealized_justified: tuple[int, bytes] | None = None
+
+
+class ForkChoiceError(ValueError):
+    pass
+
+
+class ForkChoice:
+    def __init__(
+        self,
+        store: ForkChoiceStore,
+        proto_array: ProtoArray,
+        slots_per_epoch: int,
+    ):
+        self.store = store
+        self.proto = proto_array
+        self.slots_per_epoch = slots_per_epoch
+        n = len(store.justified_balances)
+        # votes: per-validator (current message root idx, next message root
+        # idx into proto.indices-space roots, target epoch of next message)
+        self._vote_current = {}
+        self._vote_next: dict[int, bytes] = {}
+        self._vote_current_root: dict[int, bytes] = {}
+        self._vote_next_epoch: dict[int, int] = {}
+        self._equivocating: set[int] = set()
+        self._queued_attestations: list[tuple[int, list[int], bytes, int]] = []
+        self._balances_used = store.justified_balances.copy()
+        self.head_root: bytes | None = None
+
+    # -- time ----------------------------------------------------------------
+
+    def update_time(self, current_slot: int) -> None:
+        while self.store.current_slot < current_slot:
+            self.store.current_slot += 1
+            if self.store.current_slot % self.slots_per_epoch == 0:
+                self._process_queued_attestations()
+
+    def _current_epoch(self) -> int:
+        return self.store.current_slot // self.slots_per_epoch
+
+    # -- block import --------------------------------------------------------
+
+    def on_block(
+        self,
+        slot: int,
+        root: bytes,
+        parent_root: bytes,
+        state_root: bytes,
+        justified_checkpoint: tuple[int, bytes],
+        finalized_checkpoint: tuple[int, bytes],
+        justified_balances: np.ndarray | None = None,
+        execution_status: str = "pre_merge",
+    ) -> None:
+        """Register an imported block (caller has fully verified it —
+        reference onBlock precondition)."""
+        if parent_root not in self.proto.indices and len(self.proto.nodes) > 0:
+            raise ForkChoiceError("unknown parent")
+        if justified_checkpoint[0] > self.store.justified_checkpoint[0]:
+            self.store.justified_checkpoint = justified_checkpoint
+            if justified_balances is not None:
+                self.store.justified_balances = justified_balances
+        if finalized_checkpoint[0] > self.store.finalized_checkpoint[0]:
+            self.store.finalized_checkpoint = finalized_checkpoint
+        self.proto.on_block(
+            slot,
+            root,
+            parent_root if len(self.proto.nodes) > 0 else None,
+            state_root,
+            justified_checkpoint[0],
+            finalized_checkpoint[0],
+            execution_status,
+        )
+
+    # -- attestations --------------------------------------------------------
+
+    def on_attestation(
+        self,
+        validator_indices: list[int],
+        block_root: bytes,
+        target_epoch: int,
+    ) -> None:
+        """Record LMD votes (caller validated the attestation). Future-epoch
+        attestations queue until their epoch (reference queues by slot)."""
+        if target_epoch > self._current_epoch():
+            self._queued_attestations.append(
+                (target_epoch, list(validator_indices), block_root, target_epoch)
+            )
+            return
+        if block_root not in self.proto.indices:
+            raise ForkChoiceError("attestation for unknown block")
+        for v in validator_indices:
+            if v in self._equivocating:
+                continue
+            prev_epoch = self._vote_next_epoch.get(v, -1)
+            if target_epoch > prev_epoch:
+                self._vote_next[v] = block_root
+                self._vote_next_epoch[v] = target_epoch
+
+    def on_attester_slashing(self, validator_indices: list[int]) -> None:
+        """Equivocating validators stop counting (reference
+        forkChoice.onAttesterSlashing)."""
+        self._equivocating.update(validator_indices)
+
+    def _process_queued_attestations(self) -> None:
+        epoch = self._current_epoch()
+        still: list = []
+        for item in self._queued_attestations:
+            if item[0] <= epoch:
+                try:
+                    self.on_attestation(item[1], item[2], item[3])
+                except ForkChoiceError:
+                    pass
+            else:
+                still.append(item)
+        self._queued_attestations = still
+
+    # -- head ----------------------------------------------------------------
+
+    def _compute_deltas(self) -> np.ndarray:
+        """Vectorized computeDeltas: subtract old-vote weight, add new-vote
+        weight, per node — two bincounts over node indices."""
+        n_nodes = len(self.proto.nodes)
+        deltas = np.zeros(n_nodes, np.int64)
+        old_bal = self._balances_used
+        new_bal = self.store.justified_balances
+
+        sub_idx, sub_w, add_idx, add_w = [], [], [], []
+        for v, next_root in list(self._vote_next.items()):
+            equiv = v in self._equivocating
+            cur_root = self._vote_current_root.get(v)
+            if cur_root is not None and cur_root in self.proto.indices:
+                w = int(old_bal[v]) if v < len(old_bal) else 0
+                sub_idx.append(self.proto.indices[cur_root])
+                sub_w.append(w)
+            if not equiv and next_root in self.proto.indices:
+                w = int(new_bal[v]) if v < len(new_bal) else 0
+                add_idx.append(self.proto.indices[next_root])
+                add_w.append(w)
+                self._vote_current_root[v] = next_root
+            elif equiv:
+                self._vote_current_root.pop(v, None)
+                self._vote_next.pop(v, None)
+        if sub_idx:
+            deltas -= np.bincount(
+                np.asarray(sub_idx), weights=np.asarray(sub_w), minlength=n_nodes
+            ).astype(np.int64)
+        if add_idx:
+            deltas += np.bincount(
+                np.asarray(add_idx), weights=np.asarray(add_w), minlength=n_nodes
+            ).astype(np.int64)
+        self._balances_used = new_bal.copy()
+        return deltas
+
+    def update_head(self) -> bytes:
+        """Apply pending vote deltas, refresh scores, walk to head
+        (reference updateHead :184)."""
+        deltas = self._compute_deltas()
+        self.proto.apply_score_changes(
+            deltas,
+            self.store.justified_checkpoint[0],
+            self.store.finalized_checkpoint[0],
+        )
+        self.head_root = self.proto.find_head(self.store.justified_checkpoint[1])
+        return self.head_root
+
+    # -- queries -------------------------------------------------------------
+
+    def get_ancestor(self, root: bytes, slot: int) -> bytes | None:
+        return self.proto.get_ancestor_at_slot(root, slot)
+
+    def has_block(self, root: bytes) -> bool:
+        return root in self.proto.indices
+
+    def prune(self) -> None:
+        self.proto.maybe_prune(self.store.finalized_checkpoint[1])

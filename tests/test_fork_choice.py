@@ -1,0 +1,121 @@
+"""Fork-choice unit tests: proto-array head selection, votes, reorgs,
+viability filtering, pruning — the behaviors the reference exercises via
+`fork_choice` spec tests (on_block/on_attestation/on_tick steps) and
+protoArray unit tests."""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
+
+
+def _root(i: int) -> bytes:
+    return i.to_bytes(32, "big")
+
+
+def make_fc(n_validators=8, balance=32):
+    genesis = _root(0)
+    proto = ProtoArray(justified_epoch=0, finalized_epoch=0)
+    proto.on_block(0, genesis, None, b"\x00" * 32, 0, 0)
+    store = ForkChoiceStore(
+        current_slot=0,
+        justified_checkpoint=(0, genesis),
+        finalized_checkpoint=(0, genesis),
+        justified_balances=np.full(n_validators, balance, np.int64),
+    )
+    return ForkChoice(store, proto, slots_per_epoch=8)
+
+
+def test_chain_head_follows_blocks():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(2, _root(2), _root(1), b"", (0, _root(0)), (0, _root(0)))
+    assert fc.update_head() == _root(2)
+
+
+def test_votes_pick_heavier_fork():
+    fc = make_fc()
+    # fork at root 1: children 2 and 3
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(2, _root(2), _root(1), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(2, _root(3), _root(1), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_attestation([0, 1, 2], _root(2), 0)
+    fc.on_attestation([3, 4], _root(3), 0)
+    assert fc.update_head() == _root(2)
+    # three more validators move to fork 3 → reorg
+    fc.on_attestation([5, 6, 7], _root(3), 0)
+    assert fc.update_head() == _root(3)
+
+
+def test_vote_moves_subtract_old_weight():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_attestation([0, 1, 2, 3, 4], _root(1), 0)
+    assert fc.update_head() == _root(1)
+    # same validators re-vote in a later epoch for the other fork
+    fc.update_time(8)
+    fc.on_attestation([0, 1, 2, 3, 4], _root(2), 1)
+    assert fc.update_head() == _root(2)
+    # old weights must have been fully removed
+    idx1 = fc.proto.indices[_root(1)]
+    assert fc.proto.weights[idx1] == 0
+
+
+def test_equivocating_validators_removed():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_attestation([0, 1, 2], _root(1), 0)
+    fc.on_attestation([3, 4], _root(2), 0)
+    assert fc.update_head() == _root(1)
+    fc.on_attester_slashing([0, 1, 2])
+    assert fc.update_head() == _root(2)
+
+
+def test_stale_justification_filtered():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    # a block on a justified_epoch=1 branch; store moves to epoch 1
+    fc.on_block(
+        2, _root(2), _root(1), b"",
+        (1, _root(1)), (0, _root(0)),
+        justified_balances=np.full(8, 32, np.int64),
+    )
+    # head from the new justified root must land on the viable branch
+    assert fc.update_head() == _root(2)
+
+
+def test_future_epoch_attestation_queued():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_attestation([0], _root(1), 0)
+    fc.on_attestation([1, 2, 3], _root(2), 1)  # queued (epoch 1 > current 0)
+    assert fc.update_head() == _root(1)
+    fc.update_time(8)  # crossing into epoch 1 drains the queue
+    assert fc.update_head() == _root(2)
+
+
+def test_ancestor_and_descendant_queries():
+    fc = make_fc()
+    for i in range(1, 5):
+        fc.on_block(i, _root(i), _root(i - 1), b"", (0, _root(0)), (0, _root(0)))
+    assert fc.proto.is_descendant(_root(1), _root(4))
+    assert not fc.proto.is_descendant(_root(4), _root(1))
+    assert fc.get_ancestor(_root(4), 2) == _root(2)
+
+
+def test_prune_keeps_post_finalized_tree():
+    fc = make_fc()
+    for i in range(1, 10):
+        fc.on_block(i, _root(i), _root(i - 1), b"", (0, _root(0)), (0, _root(0)))
+    fc.proto.prune_threshold = 2
+    # epoch stays 0: the fabricated blocks carry (0,0) checkpoints, and
+    # viability filtering compares node vs store epochs
+    fc.store.finalized_checkpoint = (0, _root(5))
+    fc.prune()
+    assert _root(4) not in fc.proto.indices
+    assert _root(5) in fc.proto.indices
+    fc.store.justified_checkpoint = (0, _root(5))
+    assert fc.update_head() == _root(9)
