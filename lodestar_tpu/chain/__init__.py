@@ -1,0 +1,10 @@
+"""Chain services (SURVEY.md §2.2 `beacon-node/src/chain/`).
+
+`BeaconChain` aggregates: the pluggable BLS verifier (`bls_verifier` — the
+IBlsVerifier slot whose TPU implementation is this framework's north star),
+clock, state/checkpoint caches, seen-caches, op pools, the block import
+pipeline, and fork-choice wiring.
+"""
+
+from .bls_verifier import CpuBlsVerifier, IBlsVerifier  # noqa: F401
+from .chain import BeaconChain  # noqa: F401

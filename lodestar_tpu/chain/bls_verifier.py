@@ -1,0 +1,143 @@
+"""The pluggable batch BLS verifier boundary.
+
+Reference: `IBlsVerifier` (`chain/bls/interface.ts:20-46`) with two
+implementations — main-thread single verifier and the worker-pool batcher
+(`multithread/index.ts:98`). Here the implementations are:
+
+- `CpuBlsVerifier` — the oracle tier, verifying via the big-int pipeline
+  (role of `BlsSingleThreadVerifier`).
+- `DeviceBlsVerifier` — wraps `lodestar_tpu.parallel.TpuBlsVerifier`
+  (single-dispatch XLA batch kernels; role of the whole worker pool).
+- `BufferedVerifier` — async batching front-end reproducing the pool's
+  dynamic batching semantics: buffer `batchable` requests up to
+  MAX_BUFFERED_SIGS or MAX_BUFFER_WAIT_MS, then verify as one batch and
+  fall back to per-set verdicts when a batch fails
+  (`multithread/index.ts:39-57,260-275`, `worker.ts:55-95`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Protocol, Sequence
+
+from ..bls import api as bls
+
+MAX_SIGNATURE_SETS_PER_JOB = 128
+MAX_BUFFERED_SIGS = 32
+MAX_BUFFER_WAIT_MS = 100
+
+
+class IBlsVerifier(Protocol):
+    def verify_signature_sets(self, sets: Sequence[bls.SignatureSet]) -> bool: ...
+
+    def verify_signature_sets_individual(
+        self, sets: Sequence[bls.SignatureSet]
+    ) -> list[bool]: ...
+
+
+class CpuBlsVerifier:
+    """Oracle-tier verifier (reference BlsSingleThreadVerifier)."""
+
+    def verify_signature_sets(self, sets) -> bool:
+        return bls.verify_signature_sets(list(sets))
+
+    def verify_signature_sets_individual(self, sets) -> list[bool]:
+        return [
+            bls.verify_signature_sets([s]) for s in sets
+        ]
+
+
+class DeviceBlsVerifier:
+    """Device-tier verifier over the XLA batch kernels."""
+
+    def __init__(self, buckets: tuple[int, ...] = (4, 16, 64, MAX_SIGNATURE_SETS_PER_JOB)):
+        from ..parallel.verifier import TpuBlsVerifier
+
+        self._inner = TpuBlsVerifier(buckets=buckets)
+        self.max_sets_per_job = buckets[-1]
+
+    def verify_signature_sets(self, sets) -> bool:
+        sets = list(sets)
+        if not sets:
+            return False
+        # chunk oversized batches (reference chunkifyMaximizeChunkSize)
+        for i in range(0, len(sets), self.max_sets_per_job):
+            if not self._inner.verify_signature_sets(sets[i : i + self.max_sets_per_job]):
+                return False
+        return True
+
+    def verify_signature_sets_individual(self, sets) -> list[bool]:
+        sets = list(sets)
+        out: list[bool] = []
+        for i in range(0, len(sets), self.max_sets_per_job):
+            out.extend(
+                self._inner.verify_signature_sets_individual(
+                    sets[i : i + self.max_sets_per_job]
+                )
+            )
+        return out
+
+
+class BufferedVerifier:
+    """Async batching front-end over any IBlsVerifier.
+
+    verify(sets, batchable=True) awaits the batched verdict for ITS sets
+    only: a failed merged batch falls back to per-set verification so one
+    bad gossip object cannot poison its neighbors (reference retry
+    semantics, worker.ts:55-95 — realized as a second batched dispatch,
+    not N round-trips)."""
+
+    def __init__(self, verifier: IBlsVerifier):
+        self.verifier = verifier
+        self._buffer: list[tuple[list[bls.SignatureSet], asyncio.Future]] = []
+        self._flush_task: asyncio.Task | None = None
+        self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
+
+    async def verify(self, sets: Sequence[bls.SignatureSet], batchable: bool = False) -> bool:
+        sets = list(sets)
+        if not sets:
+            return False
+        if not batchable:
+            return self.verifier.verify_signature_sets(sets)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._buffer.append((sets, fut))
+        buffered = sum(len(s) for s, _ in self._buffer)
+        if buffered >= MAX_BUFFERED_SIGS:
+            self._flush()
+        elif self._flush_task is None:
+            self._flush_task = loop.create_task(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self) -> None:
+        await asyncio.sleep(MAX_BUFFER_WAIT_MS / 1000)
+        self._flush()
+
+    def _flush(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        buffer, self._buffer = self._buffer, []
+        if not buffer:
+            return
+        merged: list[bls.SignatureSet] = []
+        for sets, _ in buffer:
+            merged.extend(sets)
+        self.metrics["batches"] += 1
+        self.metrics["sigs_verified"] += len(merged)
+        ok = self.verifier.verify_signature_sets(merged)
+        if ok:
+            for _, fut in buffer:
+                if not fut.done():
+                    fut.set_result(True)
+            return
+        # batch failed: resolve per-request from one individual pass
+        self.metrics["batch_fallbacks"] += 1
+        verdicts = self.verifier.verify_signature_sets_individual(merged)
+        pos = 0
+        for sets, fut in buffer:
+            share = verdicts[pos : pos + len(sets)]
+            pos += len(sets)
+            if not fut.done():
+                fut.set_result(all(share))

@@ -1,0 +1,300 @@
+"""BeaconChain: the chain aggregate + block import pipeline.
+
+Reference: `chain/chain.ts:66` (BeaconChain), `chain/blocks/` (BlockProcessor
+→ verifyBlocksSanityChecks → verifyBlocksInEpoch [state transition ∥
+signatures ∥ execution] → importBlock), `chain/produceBlock/`.
+
+The import pipeline keeps the reference's separation: sanity checks →
+state transition WITHOUT inline signature checks → ONE batched signature
+verification over all sets of the segment (through the pluggable verifier
+— TPU path) → fork-choice/cache/pool import.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls import api as bls
+from ..config.beacon_config import compute_signing_root
+from ..params import DOMAIN_BEACON_ATTESTER
+from ..state_transition import CachedBeaconState, process_slots
+from ..state_transition.block import BlockProcessingError, get_attesting_indices
+from ..state_transition.epoch import _get_block_root
+from ..state_transition.signature_sets import get_block_signature_sets
+from ..state_transition.stf import state_transition
+from ..state_transition import util as st_util
+from ..fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
+from .bls_verifier import CpuBlsVerifier, IBlsVerifier
+from .clock import BeaconClock, ManualClock
+from .op_pools import AggregatedAttestationPool, AttestationPool, OpPool
+from .seen_cache import (
+    SeenAggregatedAttestations,
+    SeenAggregators,
+    SeenAttesters,
+    SeenBlockProposers,
+)
+from .state_cache import CheckpointStateCache, StateContextCache
+
+
+class BlockImportError(ValueError):
+    pass
+
+
+class BeaconChain:
+    """Single-process chain service (the composition the reference builds
+    in `chain.ts` ctor: verifier, clock, caches, pools, fork choice)."""
+
+    def __init__(
+        self,
+        config,
+        types,
+        anchor_state,
+        verifier: IBlsVerifier | None = None,
+        clock: BeaconClock | None = None,
+        db=None,
+    ):
+        self.config = config
+        self.types = types
+        self.preset = config.preset
+        self.bls = verifier if verifier is not None else CpuBlsVerifier()
+
+        cached = CachedBeaconState(config, anchor_state, self.preset)
+        self.head_state = cached
+        anchor_root = _anchor_block_root(anchor_state)
+        self.genesis_time = anchor_state.genesis_time
+
+        self.clock = clock if clock is not None else ManualClock(
+            self.genesis_time, config.SECONDS_PER_SLOT, self.preset.SLOTS_PER_EPOCH
+        )
+
+        proto = ProtoArray(
+            justified_epoch=anchor_state.current_justified_checkpoint.epoch,
+            finalized_epoch=anchor_state.finalized_checkpoint.epoch,
+        )
+        proto.on_block(
+            anchor_state.slot,
+            anchor_root,
+            None,
+            anchor_state.hash_tree_root(),
+            anchor_state.current_justified_checkpoint.epoch,
+            anchor_state.finalized_checkpoint.epoch,
+        )
+        store = ForkChoiceStore(
+            current_slot=anchor_state.slot,
+            justified_checkpoint=(
+                anchor_state.current_justified_checkpoint.epoch,
+                anchor_root,
+            ),
+            finalized_checkpoint=(
+                anchor_state.finalized_checkpoint.epoch,
+                anchor_root,
+            ),
+            justified_balances=cached.flat.effective_balance.astype(np.int64),
+        )
+        self.fork_choice = ForkChoice(store, proto, self.preset.SLOTS_PER_EPOCH)
+        self.head_root = anchor_root
+
+        self.state_cache = StateContextCache()
+        self.checkpoint_state_cache = CheckpointStateCache()
+        self.state_cache.add(
+            anchor_state.hash_tree_root(), cached, block_root=anchor_root
+        )
+
+        self.attestation_pool = AttestationPool()
+        self.aggregated_pool = AggregatedAttestationPool()
+        self.op_pool = OpPool()
+        self.seen_attesters = SeenAttesters()
+        self.seen_aggregators = SeenAggregators()
+        self.seen_block_proposers = SeenBlockProposers()
+        self.seen_aggregated = SeenAggregatedAttestations()
+        self.blocks: dict[bytes, object] = {anchor_root: None}
+        self.finalized_blocks: dict[bytes, object] = {}
+
+        from ..db import BeaconDb
+        from .archiver import Archiver
+        from .regen import StateRegenerator
+
+        self.db = db if db is not None else BeaconDb(types)
+        self.regen = StateRegenerator(self)
+        self.archiver = Archiver(self, self.db)
+
+    # -- block import (reference chain/blocks pipeline) ----------------------
+
+    def process_block(self, signed_block, verify_signatures: bool = True):
+        block = signed_block.message
+        block_root = block.hash_tree_root()
+        # sanity checks (verifyBlocksSanityChecks)
+        if block_root in self.blocks:
+            return block_root  # already known
+        parent_root = bytes(block.parent_root)
+        if parent_root not in self.blocks:
+            raise BlockImportError(f"unknown parent {parent_root.hex()}")
+        finalized_slot = st_util.compute_start_slot_at_epoch(
+            self.fork_choice.store.finalized_checkpoint[0],
+            self.preset.SLOTS_PER_EPOCH,
+        )
+        if block.slot <= finalized_slot:
+            raise BlockImportError("block slot not after finalized")
+
+        # pre-state
+        pre = self._get_pre_state(signed_block)
+        # state transition without inline signature verification
+        post = pre.copy()
+        state_transition(
+            post, self.types, signed_block,
+            verify_state_root=True, verify_signatures=False,
+        )
+        # batched signature verification via the pluggable verifier (the
+        # post state's epoch context covers the block's committees/proposer)
+        if verify_signatures:
+            sets = get_block_signature_sets(post, self.types, signed_block)
+            if not self.bls.verify_signature_sets(sets):
+                raise BlockImportError("block signature set verification failed")
+
+        self._import_block(signed_block, block_root, post)
+        return block_root
+
+    def _get_pre_state(self, signed_block) -> CachedBeaconState:
+        """Pre-state via regen: cache fast path, replay fallback
+        (reference: regen.getPreState from the BlockProcessor)."""
+        from .regen import RegenError
+
+        try:
+            return self.regen.get_pre_state(signed_block.message)
+        except RegenError as e:
+            raise BlockImportError(str(e)) from e
+
+    def _import_block(self, signed_block, block_root: bytes, post) -> None:
+        block = signed_block.message
+        state = post.state
+        prev_finalized = self.fork_choice.store.finalized_checkpoint[0]
+        # fork choice
+        self.fork_choice.update_time(max(self.clock.current_slot, block.slot))
+        self.fork_choice.on_block(
+            block.slot,
+            block_root,
+            bytes(block.parent_root),
+            bytes(block.state_root),
+            (
+                state.current_justified_checkpoint.epoch,
+                bytes(state.current_justified_checkpoint.root),
+            ),
+            (
+                state.finalized_checkpoint.epoch,
+                bytes(state.finalized_checkpoint.root),
+            ),
+            justified_balances=post.flat.effective_balance.astype(np.int64),
+        )
+        # per-attestation fork-choice votes (importBlock.ts:88-130)
+        for att in block.body.attestations:
+            try:
+                indices = get_attesting_indices(
+                    post, att.data, att.aggregation_bits
+                )
+                self.fork_choice.on_attestation(
+                    indices, bytes(att.data.beacon_block_root), att.data.target.epoch
+                )
+            except Exception:
+                continue
+        self.blocks[block_root] = signed_block
+        self.db.block.put(block_root, signed_block)
+        self.state_cache.add(state.hash_tree_root(), post, block_root=block_root)
+        self.seen_block_proposers.add(block.slot, block.proposer_index)
+        self.head_state = post
+        self.update_head()
+        # prune + archive on finalization advance
+        fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
+        if fin_epoch > prev_finalized:
+            self.seen_attesters.prune(fin_epoch)
+            self.seen_aggregators.prune(fin_epoch)
+            self.seen_aggregated.prune(fin_epoch)
+            self.checkpoint_state_cache.prune_finalized(fin_epoch)
+            self.archiver.process_finalized()
+        self.aggregated_pool.prune(post.current_epoch)
+
+    def update_head(self) -> bytes:
+        self.head_root = self.fork_choice.update_head()
+        head_state = self.state_cache.get_by_block_root(self.head_root)
+        if head_state is not None:
+            self.head_state = head_state
+        return self.head_root
+
+    # -- attestation intake (gossip path) ------------------------------------
+
+    def on_gossip_attestation(self, attestation, data_root: bytes) -> None:
+        self.attestation_pool.add(attestation, data_root)
+
+    def on_aggregated_attestation(self, attestation, data_root: bytes) -> None:
+        self.aggregated_pool.add(attestation, data_root)
+        try:
+            state = self.head_state
+            indices = get_attesting_indices(
+                state, attestation.data, attestation.aggregation_bits
+            )
+            self.fork_choice.update_time(self.clock.current_slot)
+            self.fork_choice.on_attestation(
+                indices,
+                bytes(attestation.data.beacon_block_root),
+                attestation.data.target.epoch,
+            )
+        except Exception:
+            pass
+
+    # -- block production (chain/produceBlock) -------------------------------
+
+    def produce_block(self, slot: int, randao_reveal: bytes, graffiti: bytes = b""):
+        """Assemble an unsigned block on the current head (reference
+        produceBlock/produceBlockBody: pools → ops, eth1 vote, state root)."""
+        pre = self.head_state.copy()
+        if slot > pre.state.slot:
+            process_slots(pre, self.types, slot)
+        proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+        attestations = self.aggregated_pool.get_attestations_for_block(
+            self.types, pre, self.preset.MAX_ATTESTATIONS
+        )
+        prop_slash, att_slash, exits = self.op_pool.get_slashings_and_exits(
+            pre, self.preset
+        )
+        body = self.types.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=pre.state.eth1_data.copy(),
+            graffiti=graffiti.ljust(32, b"\x00")[:32],
+            proposer_slashings=[s.copy() for s in prop_slash],
+            attester_slashings=[s.copy() for s in att_slash],
+            attestations=attestations,
+            voluntary_exits=[e.copy() for e in exits],
+        )
+        block = self.types.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=pre.state.latest_block_header.hash_tree_root(),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        trial = pre.copy()
+        state_transition(
+            trial,
+            self.types,
+            self.types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
+            verify_state_root=False,
+            verify_signatures=False,
+        )
+        block.state_root = trial.state.hash_tree_root()
+        return block
+
+    @property
+    def finalized_checkpoint(self):
+        return self.fork_choice.store.finalized_checkpoint
+
+    @property
+    def justified_checkpoint(self):
+        return self.fork_choice.store.justified_checkpoint
+
+
+def _anchor_block_root(state) -> bytes:
+    hdr = state.latest_block_header.copy()
+    if hdr.state_root == b"\x00" * 32:
+        hdr.state_root = state.hash_tree_root()
+    return hdr.hash_tree_root()
+
+

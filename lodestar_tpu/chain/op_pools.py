@@ -1,0 +1,168 @@
+"""Operation mempools.
+
+Reference: `chain/opPools/` — `AttestationPool` (unaggregated, per-slot,
+aggregates on insert), `AggregatedAttestationPool` (block packing via
+greedy not-yet-seen coverage, `aggregatedAttestationPool.ts:108`),
+`OpPool` (slashings + exits)."""
+
+from __future__ import annotations
+
+from ..bls import api as bls
+
+
+class AttestationPool:
+    """Unaggregated gossip attestations, aggregated on insert per
+    (slot, data_root). Signature aggregation is G2 point addition (cheap,
+    host); retained for SLOTS_RETAINED slots."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self):
+        # slot → data_root → (data, bits list[bool], agg signature point)
+        self._by_slot: dict[int, dict[bytes, tuple[object, list[bool], object]]] = {}
+
+    def add(self, attestation, data_root: bytes) -> str:
+        slot = attestation.data.slot
+        by_root = self._by_slot.setdefault(slot, {})
+        bits = list(attestation.aggregation_bits)
+        sig = bls.Signature.from_bytes(bytes(attestation.signature), validate=False)
+        entry = by_root.get(data_root)
+        if entry is None:
+            by_root[data_root] = (attestation.data.copy(), bits, sig.point)
+            return "added"
+        data, agg_bits, agg_sig = entry
+        new_bits = [b for b in bits]
+        if all(ab or not nb for ab, nb in zip(agg_bits, new_bits)):
+            return "already_known"
+        merged = [a or b for a, b in zip(agg_bits, new_bits)]
+        by_root[data_root] = (data, merged, agg_sig + sig.point)
+        return "aggregated"
+
+    def get_aggregate(self, slot: int, data_root: bytes):
+        entry = self._by_slot.get(slot, {}).get(data_root)
+        if entry is None:
+            return None
+        data, bits, sig_point = entry
+        return data, bits, bls.Signature(sig_point)
+
+    def prune(self, clock_slot: int) -> None:
+        self._by_slot = {
+            s: v
+            for s, v in self._by_slot.items()
+            if s >= clock_slot - self.SLOTS_RETAINED
+        }
+
+
+class AggregatedAttestationPool:
+    """Aggregates (from gossip aggregate-and-proof or local aggregation)
+    grouped by (target epoch, data root); `get_attestations_for_block`
+    packs greedily by fresh-coverage count (reference
+    getAttestationsForBlock)."""
+
+    EPOCHS_RETAINED = 2
+
+    def __init__(self):
+        # data_root → (data, list[(bits, signature_bytes)])
+        self._by_root: dict[bytes, tuple[object, list[tuple[list[bool], bytes]]]] = {}
+        self._epoch_of_root: dict[bytes, int] = {}
+
+    def add(self, attestation, data_root: bytes) -> None:
+        bits = list(attestation.aggregation_bits)
+        data, variants = self._by_root.setdefault(
+            data_root, (attestation.data.copy(), [])
+        )
+        variants.append((bits, bytes(attestation.signature)))
+        self._epoch_of_root[data_root] = attestation.data.target.epoch
+
+    def get_attestations_for_block(self, types, cached, max_attestations: int):
+        """Pick the best variant per data root, preferring recent slots and
+        maximal coverage; validity-filter against the block's state."""
+        state = cached.state
+        p = cached.preset
+        candidates = []
+        for data_root, (data, variants) in self._by_root.items():
+            if not (
+                data.slot + p.MIN_ATTESTATION_INCLUSION_DELAY
+                <= state.slot
+                <= data.slot + p.SLOTS_PER_EPOCH
+            ):
+                continue
+            epoch = data.target.epoch
+            if epoch == cached.current_epoch:
+                if data.source != state.current_justified_checkpoint:
+                    continue
+            elif epoch == cached.previous_epoch:
+                if data.source != state.previous_justified_checkpoint:
+                    continue
+            else:
+                continue
+            best = max(variants, key=lambda v: sum(v[0]))
+            candidates.append((sum(best[0]), data.slot, data, best))
+        candidates.sort(key=lambda c: (-c[1], -c[0]))  # recent slots, most bits
+        out = []
+        for _, _, data, (bits, sig) in candidates[:max_attestations]:
+            out.append(
+                types.Attestation(
+                    aggregation_bits=bits, data=data.copy(), signature=sig
+                )
+            )
+        return out
+
+    def prune(self, current_epoch: int) -> None:
+        stale = [
+            r
+            for r, e in self._epoch_of_root.items()
+            if e + self.EPOCHS_RETAINED < current_epoch
+        ]
+        for r in stale:
+            self._by_root.pop(r, None)
+            self._epoch_of_root.pop(r, None)
+
+
+class OpPool:
+    """Slashings, exits — persisted ops awaiting block inclusion
+    (reference opPool.ts; per-validator dedup)."""
+
+    def __init__(self):
+        self.proposer_slashings: dict[int, object] = {}
+        self.attester_slashings: list[object] = []
+        self.voluntary_exits: dict[int, object] = {}
+
+    def add_proposer_slashing(self, slashing) -> None:
+        self.proposer_slashings[slashing.signed_header_1.message.proposer_index] = (
+            slashing
+        )
+
+    def add_attester_slashing(self, slashing) -> None:
+        self.attester_slashings.append(slashing)
+
+    def add_voluntary_exit(self, signed_exit) -> None:
+        self.voluntary_exits[signed_exit.message.validator_index] = signed_exit
+
+    def get_slashings_and_exits(self, cached, preset):
+        from ..state_transition.block import is_slashable_validator
+
+        proposer = [
+            s
+            for idx, s in self.proposer_slashings.items()
+            if is_slashable_validator(cached.flat, idx, cached.current_epoch)
+        ][: preset.MAX_PROPOSER_SLASHINGS]
+        attester = self.attester_slashings[: preset.MAX_ATTESTER_SLASHINGS]
+        exits = [
+            e
+            for idx, e in self.voluntary_exits.items()
+            if int(cached.flat.exit_epoch[idx]) == 2**64 - 1
+        ][: preset.MAX_VOLUNTARY_EXITS]
+        return proposer, attester, exits
+
+    def prune(self, cached) -> None:
+        self.proposer_slashings = {
+            i: s
+            for i, s in self.proposer_slashings.items()
+            if not bool(cached.flat.slashed[i])
+        }
+        self.voluntary_exits = {
+            i: e
+            for i, e in self.voluntary_exits.items()
+            if int(cached.flat.exit_epoch[i]) == 2**64 - 1
+        }

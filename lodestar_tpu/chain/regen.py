@@ -1,0 +1,90 @@
+"""State regeneration: produce any hot state by replaying blocks from the
+nearest cached ancestor state.
+
+Reference: `chain/regen/` — `QueuedStateRegenerator` (queued.ts:27) /
+`StateRegenerator` (regen.ts:35-115): getPreState / getCheckpointState /
+getState with checkpoint- and state-cache fast paths, block replay with
+signature verification OFF (blocks were verified on first import).
+"""
+
+from __future__ import annotations
+
+from ..state_transition import process_slots
+from ..state_transition.stf import state_transition
+from ..state_transition import util as st_util
+
+
+class RegenError(ValueError):
+    pass
+
+
+class StateRegenerator:
+    def __init__(self, chain):
+        self.chain = chain
+
+    def get_state_by_root(self, state_root: bytes):
+        cached = self.chain.state_cache.get(state_root)
+        if cached is not None:
+            return cached
+        raise RegenError("state root not in hot cache; replay requires block root")
+
+    def get_state_for_block(self, block_root: bytes):
+        """State after applying the block with `block_root` (replaying
+        ancestors from the nearest cached state if needed)."""
+        cached = self.chain.state_cache.get_by_block_root(block_root)
+        if cached is not None:
+            return cached
+        # walk back through fork choice ancestry to a cached state
+        chain_path = []
+        root = block_root
+        base = None
+        while True:
+            node = self.chain.fork_choice.proto.get_node(root)
+            if node is None:
+                raise RegenError(f"unknown block {root.hex()}")
+            cached = self.chain.state_cache.get_by_block_root(root)
+            if cached is not None:
+                base = cached
+                break
+            chain_path.append(root)
+            if node.parent is None:
+                raise RegenError("no cached ancestor state to replay from")
+            root = self.chain.fork_choice.proto.nodes[node.parent].root
+        # replay forward
+        state = base.copy()
+        for r in reversed(chain_path):
+            signed = self.chain.blocks.get(r)
+            if signed is None:
+                raise RegenError(f"missing block body for {r.hex()}")
+            state_transition(
+                state, self.chain.types, signed,
+                verify_state_root=False, verify_signatures=False,
+            )
+            self.chain.state_cache.add(
+                state.state.hash_tree_root(), state.copy(), block_root=r
+            )
+        return state
+
+    def get_pre_state(self, block) -> object:
+        """Pre-state for a block: parent state advanced to the block's slot
+        (reference getPreState — the BlockProcessor entry point)."""
+        pre = self.get_state_for_block(bytes(block.parent_root))
+        pre = pre.copy()
+        if block.slot > pre.state.slot:
+            process_slots(pre, self.chain.types, block.slot)
+        return pre
+
+    def get_checkpoint_state(self, epoch: int, root: bytes):
+        """Epoch-boundary state for (epoch, root) — the attestation-target
+        state (reference getCheckpointState)."""
+        hit = self.chain.checkpoint_state_cache.get(epoch, root)
+        if hit is not None:
+            return hit
+        state = self.get_state_for_block(root).copy()
+        boundary = st_util.compute_start_slot_at_epoch(
+            epoch, self.chain.preset.SLOTS_PER_EPOCH
+        )
+        if state.state.slot < boundary:
+            process_slots(state, self.chain.types, boundary)
+        self.chain.checkpoint_state_cache.add(epoch, root, state)
+        return state

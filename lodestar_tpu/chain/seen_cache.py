@@ -1,0 +1,85 @@
+"""Anti-duplication gossip caches (reference: `chain/seenCache/*.ts` —
+SeenAttesters, SeenAggregators, SeenBlockProposers, SeenAggregatedAttestations).
+
+Epoch-keyed maps pruned on finalization; the aggregated-attestation cache
+keeps seen aggregation-bit sets per attestation-data root and answers
+non-strict-superset queries ("is this aggregate already covered?")."""
+
+from __future__ import annotations
+
+
+class SeenByEpoch:
+    """epoch → {validator index} (SeenAttesters / SeenAggregators)."""
+
+    def __init__(self):
+        self._by_epoch: dict[int, set[int]] = {}
+        self.lowest_permissible_epoch = 0
+
+    def is_known(self, epoch: int, index: int) -> bool:
+        return index in self._by_epoch.get(epoch, ())
+
+    def add(self, epoch: int, index: int) -> None:
+        if epoch < self.lowest_permissible_epoch:
+            raise ValueError("epoch below pruned horizon")
+        self._by_epoch.setdefault(epoch, set()).add(index)
+
+    def prune(self, finalized_epoch: int) -> None:
+        self.lowest_permissible_epoch = finalized_epoch
+        self._by_epoch = {
+            e: s for e, s in self._by_epoch.items() if e >= finalized_epoch
+        }
+
+
+SeenAttesters = SeenByEpoch
+SeenAggregators = SeenByEpoch
+
+
+class SeenBlockProposers:
+    """slot → {proposer index} (duplicate block proposal detection)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[int]] = {}
+
+    def is_known(self, slot: int, proposer: int) -> bool:
+        return proposer in self._by_slot.get(slot, ())
+
+    def add(self, slot: int, proposer: int) -> None:
+        self._by_slot.setdefault(slot, set()).add(proposer)
+
+    def prune(self, finalized_slot: int) -> None:
+        self._by_slot = {s: v for s, v in self._by_slot.items() if s >= finalized_slot}
+
+
+class SeenAggregatedAttestations:
+    """data_root → list of seen aggregation-bit tuples; an incoming
+    aggregate is redundant iff some seen bitset is a non-strict superset
+    (reference seenAggregatedAttestations non-strict superset check)."""
+
+    def __init__(self):
+        self._by_root: dict[bytes, list[tuple[bool, ...]]] = {}
+        self._epoch_of_root: dict[bytes, int] = {}
+
+    def is_known_superset(self, data_root: bytes, bits: list[bool]) -> bool:
+        for seen in self._by_root.get(data_root, ()):
+            if len(seen) == len(bits) and all(
+                s or not b for s, b in zip(seen, bits)
+            ):
+                return True
+        return False
+
+    def add(self, epoch: int, data_root: bytes, bits: list[bool]) -> None:
+        entry = tuple(bits)
+        existing = self._by_root.setdefault(data_root, [])
+        # drop strictly-dominated entries to bound growth
+        existing[:] = [
+            s for s in existing
+            if not (len(s) == len(entry) and all(e or not b for e, b in zip(entry, s)))
+        ]
+        existing.append(entry)
+        self._epoch_of_root[data_root] = epoch
+
+    def prune(self, finalized_epoch: int) -> None:
+        stale = [r for r, e in self._epoch_of_root.items() if e < finalized_epoch]
+        for r in stale:
+            self._by_root.pop(r, None)
+            self._epoch_of_root.pop(r, None)

@@ -1,0 +1,187 @@
+"""Gossip object validation (consensus p2p spec REJECT/IGNORE ladders).
+
+Reference: `chain/validation/attestation.ts:15` (the full ladder for
+`beacon_attestation_{subnet}`), `aggregateAndProof.ts`, `block.ts`.
+Outcomes mirror gossipsub validation results: ACCEPT / IGNORE (don't
+propagate, no penalty) / REJECT (penalize peer).
+
+The signature check goes through the chain's pluggable verifier with
+`batchable=True` semantics — on the TPU tier that means the attestation
+joins the next batched device dispatch (reference: `{batchable: true}` at
+attestation.ts:139).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+from ..state_transition import util as st_util
+from ..state_transition.signature_sets import indexed_attestation_signature_set
+
+
+class GossipAction(str, Enum):
+    ACCEPT = "ACCEPT"
+    IGNORE = "IGNORE"
+    REJECT = "REJECT"
+
+
+@dataclass
+class ValidationResult:
+    action: GossipAction
+    reason: str = ""
+    attesting_index: int | None = None
+    data_root: bytes | None = None
+
+
+def validate_gossip_attestation(
+    chain, types, attestation, subnet: int | None
+) -> ValidationResult:
+    """The beacon_attestation_{subnet} ladder (attestation.ts ordering)."""
+    p = chain.preset
+    data = attestation.data
+
+    # [REJECT] exactly one aggregation bit
+    bits = list(attestation.aggregation_bits)
+    if sum(1 for b in bits if b) != 1:
+        return ValidationResult(GossipAction.REJECT, "not exactly one bit set")
+
+    # [IGNORE] slot within ATTESTATION_PROPAGATION_SLOT_RANGE of clock
+    clock_slot = chain.clock.current_slot
+    if not (
+        data.slot <= clock_slot
+        and clock_slot <= data.slot + p.SLOTS_PER_EPOCH
+    ):
+        return ValidationResult(GossipAction.IGNORE, "slot out of propagation range")
+
+    # [REJECT] target epoch consistency
+    if data.target.epoch != st_util.compute_epoch_at_slot(
+        data.slot, p.SLOTS_PER_EPOCH
+    ):
+        return ValidationResult(GossipAction.REJECT, "target epoch mismatch")
+
+    # [IGNORE] unknown head block (may arrive later → reprocess queue)
+    head_block_root = bytes(data.beacon_block_root)
+    if not chain.fork_choice.has_block(head_block_root):
+        return ValidationResult(GossipAction.IGNORE, "unknown beacon_block_root")
+
+    # [REJECT] target must be an ancestor of the head block
+    target_slot = st_util.compute_start_slot_at_epoch(
+        data.target.epoch, p.SLOTS_PER_EPOCH
+    )
+    target_ancestor = chain.fork_choice.get_ancestor(head_block_root, target_slot)
+    if target_ancestor != bytes(data.target.root):
+        return ValidationResult(GossipAction.REJECT, "target not ancestor of head")
+
+    # committee lookup via the target checkpoint state (shuffling cache)
+    try:
+        target_state = chain.regen.get_checkpoint_state(
+            data.target.epoch, bytes(data.target.root)
+        )
+    except Exception:
+        return ValidationResult(GossipAction.IGNORE, "target state unavailable")
+    ctx = target_state.epoch_ctx
+
+    # [REJECT] committee index in range
+    if data.index >= ctx.get_committee_count_per_slot(data.target.epoch):
+        return ValidationResult(GossipAction.REJECT, "committee index out of range")
+    committee = ctx.get_beacon_committee(data.slot, data.index)
+    if len(bits) != len(committee):
+        return ValidationResult(GossipAction.REJECT, "wrong bits length")
+
+    # [REJECT] correct subnet
+    if subnet is not None:
+        expected = compute_subnet_for_attestation(
+            ctx, data.slot, data.index, p
+        )
+        if subnet != expected:
+            return ValidationResult(GossipAction.REJECT, "wrong subnet")
+
+    attester_index = int(committee[bits.index(True)])
+
+    # [IGNORE] already seen for this target epoch
+    if chain.seen_attesters.is_known(data.target.epoch, attester_index):
+        return ValidationResult(GossipAction.IGNORE, "already seen")
+
+    # [REJECT] signature (batchable path on the device tier)
+    sig_set = indexed_attestation_signature_set(
+        target_state,
+        types.IndexedAttestation(
+            attesting_indices=[attester_index],
+            data=data.copy(),
+            signature=bytes(attestation.signature),
+        ),
+    )
+    if not chain.bls.verify_signature_sets([sig_set]):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+
+    # re-check seen after the async verify (reference double-checks at
+    # attestation.ts:144-155 — logical race handling)
+    if chain.seen_attesters.is_known(data.target.epoch, attester_index):
+        return ValidationResult(GossipAction.IGNORE, "seen during verification")
+    chain.seen_attesters.add(data.target.epoch, attester_index)
+
+    return ValidationResult(
+        GossipAction.ACCEPT,
+        attesting_index=attester_index,
+        data_root=data.hash_tree_root(),
+    )
+
+
+def compute_subnet_for_attestation(ctx, slot: int, committee_index: int, p) -> int:
+    """Spec compute_subnet_for_attestation (reference:
+    epochContext.computeSubnetForSlot :545)."""
+    from ..params import ATTESTATION_SUBNET_COUNT
+
+    slots_since_epoch_start = slot % p.SLOTS_PER_EPOCH
+    cps = ctx.get_committee_count_per_slot(
+        st_util.compute_epoch_at_slot(slot, p.SLOTS_PER_EPOCH)
+    )
+    committees_since_epoch_start = cps * slots_since_epoch_start
+    return (committees_since_epoch_start + committee_index) % ATTESTATION_SUBNET_COUNT
+
+
+def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
+    """The beacon_block ladder (block.ts): slot/proposer/parent checks;
+    full verification happens in the import pipeline."""
+    block = signed_block.message
+    clock_slot = chain.clock.current_slot
+
+    # [IGNORE] future slot (beyond gossip clock disparity)
+    if block.slot > clock_slot:
+        return ValidationResult(GossipAction.IGNORE, "future slot")
+
+    # [IGNORE] not newer than finalized
+    fin_epoch = chain.finalized_checkpoint[0]
+    fin_slot = st_util.compute_start_slot_at_epoch(
+        fin_epoch, chain.preset.SLOTS_PER_EPOCH
+    )
+    if block.slot <= fin_slot:
+        return ValidationResult(GossipAction.IGNORE, "not after finalized slot")
+
+    # [IGNORE] already seen proposal for (slot, proposer)
+    if chain.seen_block_proposers.is_known(block.slot, block.proposer_index):
+        return ValidationResult(GossipAction.IGNORE, "duplicate proposal")
+
+    # [IGNORE] parent unknown (trigger unknown-block sync)
+    parent_root = bytes(block.parent_root)
+    if not chain.fork_choice.has_block(parent_root):
+        return ValidationResult(GossipAction.IGNORE, "unknown parent")
+
+    # [REJECT] parent slot must be lower
+    parent = chain.fork_choice.proto.get_node(parent_root)
+    if parent is not None and parent.slot >= block.slot:
+        return ValidationResult(GossipAction.REJECT, "parent slot not lower")
+
+    # [REJECT] proposer signature
+    from ..state_transition.signature_sets import block_proposer_signature_set
+
+    try:
+        head_state = chain.head_state
+        sig_set = block_proposer_signature_set(head_state, signed_block)
+        if not chain.bls.verify_signature_sets([sig_set]):
+            return ValidationResult(GossipAction.REJECT, "invalid proposer signature")
+    except Exception:
+        return ValidationResult(GossipAction.IGNORE, "cannot build signature set")
+
+    return ValidationResult(GossipAction.ACCEPT)

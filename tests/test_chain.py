@@ -1,0 +1,231 @@
+"""Chain-service e2e: a single-process dev chain (reference analog:
+`getDevBeaconNode` e2e + `dev` command, SURVEY.md §4.4) — produce blocks
+from the op pools, import through the BlockProcessor pipeline with batched
+signature verification, track fork choice head and finality."""
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain, CpuBlsVerifier
+from lodestar_tpu.chain.clock import ManualClock
+from lodestar_tpu.chain.op_pools import AttestationPool
+from lodestar_tpu.chain.seen_cache import SeenAggregatedAttestations, SeenByEpoch
+from lodestar_tpu.chain.state_cache import StateContextCache
+from lodestar_tpu.config.beacon_config import (
+    BeaconConfig,
+    ChainForkConfig,
+    compute_signing_root,
+)
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER, DOMAIN_RANDAO
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.state_transition.block import _epoch_signing_root
+from lodestar_tpu.types import get_types
+
+N_VALIDATORS = 16
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+
+@pytest.fixture(scope="module")
+def chain_env():
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, N_VALIDATORS, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    return config, types, state
+
+
+def _sk(i):
+    return bls.interop_secret_key(i)
+
+
+def _sign_block(config, types, block):
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, block.slot)
+    sig = _sk(block.proposer_index).sign(
+        compute_signing_root(block.hash_tree_root(), domain)
+    )
+    return types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+
+
+def _attest_head(config, types, chain):
+    """All committees of the head slot attest to the head (full
+    participation), pushed through the aggregated pool."""
+    cached = chain.head_state
+    state = cached.state
+    slot = state.slot
+    epoch = slot // SPE
+    start = epoch * SPE
+    head_root = chain.head_root
+    if start == slot:
+        target_root = head_root
+    else:
+        target_root = bytes(state.block_roots[start % MINIMAL.SLOTS_PER_HISTORICAL_ROOT])
+    domain = config.get_domain(DOMAIN_BEACON_ATTESTER, slot, epoch)
+    for index in range(cached.epoch_ctx.get_committee_count_per_slot(epoch)):
+        committee = cached.epoch_ctx.get_beacon_committee(slot, index)
+        data = types.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint.copy(),
+            target=types.Checkpoint(epoch=epoch, root=target_root),
+        )
+        root = compute_signing_root(data.hash_tree_root(), domain)
+        sigs = [_sk(int(v)).sign(root) for v in committee]
+        att = types.Attestation(
+            aggregation_bits=[True] * len(committee),
+            data=data,
+            signature=bls.aggregate_signatures(sigs).to_bytes(),
+        )
+        chain.on_aggregated_attestation(att, data.hash_tree_root())
+
+
+def test_dev_chain_three_epochs_with_signatures(chain_env):
+    # justification can only move at the epoch 2→3 transition (the spec
+    # skips justification while current_epoch <= GENESIS_EPOCH+1), so run 3
+    config, types, genesis_state = chain_env
+    chain = BeaconChain(config, types, genesis_state.copy(), verifier=CpuBlsVerifier())
+    from lodestar_tpu.state_transition import process_slots
+
+    for slot in range(1, 3 * SPE + 1):
+        chain.clock.set_slot(slot)
+        randao_domain = config.get_domain(DOMAIN_RANDAO, slot)
+        # proposer must be computed on a state advanced to `slot`
+        trial = chain.head_state.copy()
+        if slot > trial.state.slot:
+            process_slots(trial, types, slot)
+        proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+        reveal = _sk(proposer).sign(
+            _epoch_signing_root(slot // SPE, randao_domain)
+        ).to_bytes()
+        block = chain.produce_block(slot, randao_reveal=reveal)
+        assert block.proposer_index == proposer
+        signed = _sign_block(config, types, block)
+        root = chain.process_block(signed, verify_signatures=True)
+        assert chain.head_root == root
+        _attest_head(config, types, chain)
+    assert chain.head_state.state.slot == 3 * SPE
+    # full participation → epoch 2 justified at the 2→3 transition
+    assert chain.justified_checkpoint[0] >= 1
+
+
+def test_chain_finality_triggers_archiver(chain_env):
+    """5 unsigned-verification epochs → finalization advances, archiver
+    moves finalized blocks hot→cold, regen can still serve archived roots."""
+    config, types, genesis_state = chain_env
+    chain = BeaconChain(config, types, genesis_state.copy())
+    from lodestar_tpu.state_transition import process_slots
+
+    for slot in range(1, 5 * SPE + 1):
+        chain.clock.set_slot(slot)
+        trial = chain.head_state.copy()
+        if slot > trial.state.slot:
+            process_slots(trial, types, slot)
+        proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+        randao_domain = config.get_domain(DOMAIN_RANDAO, slot)
+        reveal = _sk(proposer).sign(
+            _epoch_signing_root(slot // SPE, randao_domain)
+        ).to_bytes()
+        block = chain.produce_block(slot, randao_reveal=reveal)
+        signed = _sign_block(config, types, block)
+        chain.process_block(signed, verify_signatures=False)
+        _attest_head(config, types, chain)
+
+    fin_epoch, fin_root = chain.finalized_checkpoint
+    assert fin_epoch >= 2
+    # archiver moved pre-finalized canonical blocks to cold storage
+    assert len(chain.finalized_blocks) > 0
+    slots = [b.message.slot for b in chain.db.block_archive.values_stream()]
+    assert slots == sorted(slots) and len(slots) == len(chain.finalized_blocks)
+    # hot set only holds blocks at/after the finalized slot
+    fin_slot = fin_epoch * SPE
+    assert all(
+        b is None or b.message.slot >= fin_slot for b in chain.blocks.values()
+    )
+
+
+def test_chain_rejects_bad_signature(chain_env):
+    config, types, genesis_state = chain_env
+    chain = BeaconChain(config, types, genesis_state.copy())
+    from lodestar_tpu.state_transition import process_slots
+
+    trial = chain.head_state.copy()
+    process_slots(trial, types, 1)
+    proposer = trial.epoch_ctx.get_beacon_proposer(1)
+    randao_domain = config.get_domain(DOMAIN_RANDAO, 1)
+    reveal = _sk(proposer).sign(_epoch_signing_root(0, randao_domain)).to_bytes()
+    block = chain.produce_block(1, randao_reveal=reveal)
+    bad = types.SignedBeaconBlock(message=block, signature=b"\x22" * 96)
+    with pytest.raises(Exception):
+        chain.process_block(bad, verify_signatures=True)
+
+
+def test_chain_rejects_unknown_parent(chain_env):
+    config, types, genesis_state = chain_env
+    chain = BeaconChain(config, types, genesis_state.copy())
+    block = types.BeaconBlock(
+        slot=1, proposer_index=0, parent_root=b"\x99" * 32,
+        state_root=b"\x00" * 32, body=types.BeaconBlockBody(),
+    )
+    with pytest.raises(Exception):
+        chain.process_block(_sign_block(config, types, block), verify_signatures=False)
+
+
+# --- unit tests for the small services --------------------------------------
+
+
+def test_seen_caches():
+    seen = SeenByEpoch()
+    assert not seen.is_known(3, 7)
+    seen.add(3, 7)
+    assert seen.is_known(3, 7)
+    seen.prune(4)
+    assert not seen.is_known(3, 7)
+
+    agg = SeenAggregatedAttestations()
+    agg.add(1, b"r" * 32, [True, False, True])
+    assert agg.is_known_superset(b"r" * 32, [True, False, False])
+    assert not agg.is_known_superset(b"r" * 32, [True, True, False])
+
+
+def test_state_cache_lru_eviction():
+    cache = StateContextCache(max_states=2)
+    cache.add(b"a" * 32, "state_a", block_root=b"A" * 32)
+    cache.add(b"b" * 32, "state_b")
+    assert cache.get(b"a" * 32) == "state_a"  # refresh a
+    cache.add(b"c" * 32, "state_c")  # evicts b
+    assert cache.get(b"b" * 32) is None
+    assert cache.get_by_block_root(b"A" * 32) == "state_a"
+
+
+def test_attestation_pool_aggregates(chain_env):
+    config, types, _ = chain_env
+    pool = AttestationPool()
+    data = types.AttestationData(
+        slot=5, index=0, beacon_block_root=b"h" * 32,
+        source=types.Checkpoint(), target=types.Checkpoint(),
+    )
+    root = data.hash_tree_root()
+    sk0, sk1 = _sk(0), _sk(1)
+    a0 = types.Attestation(
+        aggregation_bits=[True, False], data=data,
+        signature=sk0.sign(b"m" * 32).to_bytes(),
+    )
+    a1 = types.Attestation(
+        aggregation_bits=[False, True], data=data,
+        signature=sk1.sign(b"m" * 32).to_bytes(),
+    )
+    assert pool.add(a0, root) == "added"
+    assert pool.add(a1, root) == "aggregated"
+    assert pool.add(a0, root) == "already_known"
+    got = pool.get_aggregate(5, root)
+    assert got is not None
+    _, bits, agg_sig = got
+    assert bits == [True, True]
+    expected = bls.aggregate_signatures(
+        [bls.Signature.from_bytes(a0.signature), bls.Signature.from_bytes(a1.signature)]
+    )
+    assert agg_sig.to_bytes() == expected.to_bytes()
