@@ -1,0 +1,15 @@
+"""Validator client (SURVEY.md §2.1 `validator` package).
+
+Reference surface: `Validator` (validator.ts:53), `ValidatorStore` with
+slashing-protection-gated signing (`services/validatorStore.ts:307+`),
+duty services (attestationDuties.ts / attestation.ts / block.ts),
+EIP-3076 slashing protection (`slashingProtection/`).
+
+The transport here is in-process against a `BeaconChain` (the REST client
+indirection arrives with the api package); signing and protection logic is
+transport-independent.
+"""
+
+from .store import ValidatorStore  # noqa: F401
+from .slashing_protection import SlashingProtection, SlashingError  # noqa: F401
+from .service import ValidatorService  # noqa: F401

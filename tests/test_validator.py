@@ -1,0 +1,115 @@
+"""Validator client tests: slashing protection safety conditions,
+interchange round-trip, and a validator-service-driven chain reaching
+justification (reference analog: validator unit tests + sim)."""
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.db import MemoryDb
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.types import get_types
+from lodestar_tpu.validator import (
+    SlashingError,
+    SlashingProtection,
+    ValidatorService,
+    ValidatorStore,
+)
+
+SPE = MINIMAL.SLOTS_PER_EPOCH
+PK = b"\xaa" * 48
+
+
+@pytest.fixture()
+def protection():
+    return SlashingProtection(MemoryDb())
+
+
+class TestSlashingProtection:
+    def test_block_double_proposal_rejected(self, protection):
+        protection.check_and_insert_block_proposal(PK, 10, b"\x01" * 32)
+        protection.check_and_insert_block_proposal(PK, 11, b"\x02" * 32)
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_block_proposal(PK, 11, b"\x03" * 32)
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_block_proposal(PK, 5, b"\x04" * 32)
+        # identical re-sign is allowed
+        protection.check_and_insert_block_proposal(PK, 11, b"\x02" * 32)
+
+    def test_attestation_double_vote_rejected(self, protection):
+        protection.check_and_insert_attestation(PK, 1, 2, b"\x01" * 32)
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 1, 2, b"\x02" * 32)
+        protection.check_and_insert_attestation(PK, 1, 2, b"\x01" * 32)  # same root ok
+
+    def test_surrounding_vote_rejected(self, protection):
+        protection.check_and_insert_attestation(PK, 3, 4, b"\x01" * 32)
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 2, 5, b"\x02" * 32)
+
+    def test_surrounded_vote_rejected(self, protection):
+        protection.check_and_insert_attestation(PK, 2, 6, b"\x01" * 32)
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 3, 5, b"\x02" * 32)
+
+    def test_normal_progression_allowed(self, protection):
+        for e in range(1, 10):
+            protection.check_and_insert_attestation(PK, e, e + 1, bytes([e]) * 32)
+
+    def test_interchange_roundtrip(self, protection):
+        protection.check_and_insert_block_proposal(PK, 7, b"\x0b" * 32)
+        protection.check_and_insert_attestation(PK, 1, 2, b"\x0a" * 32)
+        exported = protection.export_interchange(b"\x00" * 32, [PK])
+        assert exported["metadata"]["interchange_format_version"] == "5"
+
+        fresh = SlashingProtection(MemoryDb())
+        fresh.import_interchange(exported)
+        with pytest.raises(SlashingError):
+            fresh.check_and_insert_block_proposal(PK, 7, b"\xff" * 32)
+        with pytest.raises(SlashingError):
+            fresh.check_and_insert_attestation(PK, 1, 2, b"\xff" * 32)
+
+
+def test_validator_service_drives_chain_to_justification():
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    n = 16
+    state = interop_genesis_state(fork_config, types, n, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    chain = BeaconChain(config, types, state)
+    store = ValidatorStore(config, SlashingProtection(MemoryDb()))
+    for i in range(n):
+        store.add_secret_key(bls.interop_secret_key(i))
+    service = ValidatorService(config, types, chain, store)
+
+    # duty discovery covers everyone exactly once per epoch
+    duties = service.get_attester_duties(0)
+    assert sorted(d.validator_index for d in duties) == list(range(n))
+    proposer_duties = service.get_proposer_duties(0)
+    assert len(proposer_duties) == SPE  # we own all validators
+
+    for slot in range(1, 3 * SPE + 1):
+        chain.clock.set_slot(slot)
+        signed = service.propose_block_if_due(slot)
+        assert signed is not None  # all validators are ours
+        service.attest_if_due(slot)
+
+    assert chain.justified_checkpoint[0] >= 1
+    # slashing protection must now refuse re-signing an old block slot
+    pk0 = store.pubkeys[0]
+    blk = types.BeaconBlock(slot=1, proposer_index=0)
+    seen_slots = {
+        d.slot for d in service.get_proposer_duties(chain.head_state.current_epoch)
+    }
+    with pytest.raises(SlashingError):
+        # any of our keys that proposed earlier refuses slot 1 again
+        proposer_pk = next(
+            pk for pk in store.pubkeys
+            if (store.protection.blocks.get(pk) or {}).get("max_slot", -1) >= 1
+        )
+        store.sign_block(proposer_pk, types, blk)
