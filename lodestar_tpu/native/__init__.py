@@ -1,0 +1,230 @@
+"""Native tier loader: C codecs/hashes with pure-Python fallbacks.
+
+Replaces the reference's native npm deps (SURVEY.md §2.3): as-sha256 →
+`sha256`/`sha256_level`; xxhash-wasm → `xxh64`; snappyjs → snappy codec.
+The extension builds lazily on first import (gcc via setuptools); when a
+toolchain is unavailable the hashlib/pure-Python fallbacks keep every API
+working (snappy falls back to a Python port of the same block format).
+
+`HAVE_NATIVE` reports which tier is active; `install_ssz_backend()` swaps
+the SSZ hasher to the batched native level function.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(__file__)
+HAVE_NATIVE = False
+_mod = None
+
+
+def _try_import():
+    global _mod, HAVE_NATIVE
+    try:
+        from . import _lodestar_native as m  # type: ignore[attr-defined]
+
+        _mod, HAVE_NATIVE = m, True
+        return True
+    except ImportError:
+        return False
+
+
+def _build() -> bool:
+    """Compile the extension in-place with cc (no pip required)."""
+    import sysconfig
+
+    src = [os.path.join(_HERE, "src", f) for f in (
+        "module.c", "sha256.c", "xxhash64.c", "snappy_codec.c"
+    )]
+    ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_HERE, "_lodestar_native" + ext_suffix)
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        os.environ.get("CC", "cc"), "-O2", "-shared", "-fPIC",
+        f"-I{include}", *src, "-o", out,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        return proc.returncode == 0 and os.path.exists(out)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+if not _try_import():
+    if _build():
+        _try_import()
+
+
+# --- public API (native or fallback) ---------------------------------------
+
+def sha256(data: bytes) -> bytes:
+    if HAVE_NATIVE:
+        return _mod.sha256(data)
+    return hashlib.sha256(data).digest()
+
+
+def sha256_level(data: bytes) -> bytes:
+    """N×64 bytes → N×32 bytes (one merkle level in one call)."""
+    if HAVE_NATIVE:
+        return _mod.sha256_level(data)
+    out = bytearray(len(data) // 2)
+    for i in range(0, len(data), 64):
+        out[i // 2 : i // 2 + 32] = hashlib.sha256(data[i : i + 64]).digest()
+    return bytes(out)
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    if HAVE_NATIVE:
+        return _mod.xxh64(data, seed)
+    return _xxh64_py(data, seed)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    if HAVE_NATIVE:
+        return _mod.snappy_compress(data)
+    return _snappy_compress_py(data)
+
+
+def snappy_uncompress(data: bytes) -> bytes:
+    if HAVE_NATIVE:
+        return _mod.snappy_uncompress(data)
+    return _snappy_uncompress_py(data)
+
+
+def install_ssz_backend() -> None:
+    """Route SSZ merkleization through the batched native level hasher."""
+    from ..ssz import hashing
+
+    hashing.set_hash_backend(sha256_level)
+
+
+# --- pure-Python fallbacks ---------------------------------------------------
+
+_P1, _P2, _P3, _P4, _P5 = (
+    0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+    0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5,
+)
+_M = (1 << 64) - 1
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M
+
+
+def _round(acc, inp):
+    return (_rotl((acc + inp * _P2) & _M, 31) * _P1) & _M
+
+
+def _xxh64_py(data: bytes, seed: int) -> int:
+    n = len(data)
+    p = 0
+    if n >= 32:
+        v1, v2, v3, v4 = (
+            (seed + _P1 + _P2) & _M, (seed + _P2) & _M, seed, (seed - _P1) & _M
+        )
+        while p + 32 <= n:
+            v1 = _round(v1, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v2 = _round(v2, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v3 = _round(v3, int.from_bytes(data[p : p + 8], "little")); p += 8
+            v4 = _round(v4, int.from_bytes(data[p : p + 8], "little")); p += 8
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & _M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ _round(0, v)) * _P1 + _P4) & _M
+    else:
+        h = (seed + _P5) & _M
+    h = (h + n) & _M
+    while p + 8 <= n:
+        h = ((_rotl(h ^ _round(0, int.from_bytes(data[p : p + 8], "little")), 27) * _P1) + _P4) & _M
+        p += 8
+    if p + 4 <= n:
+        h = ((_rotl(h ^ (int.from_bytes(data[p : p + 4], "little") * _P1) & _M, 23) * _P2) + _P3) & _M
+        p += 4
+    while p < n:
+        h = (_rotl(h ^ (data[p] * _P5) & _M, 11) * _P1) & _M
+        p += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M
+    h ^= h >> 29
+    h = (h * _P3) & _M
+    h ^= h >> 32
+    return h
+
+
+def _uvarint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _snappy_compress_py(data: bytes) -> bytes:
+    """Valid (all-literal) snappy block stream — correctness fallback."""
+    out = bytearray(_uvarint(len(data)))
+    i = 0
+    while i < len(data):
+        chunk = data[i : i + 65536]
+        l = len(chunk) - 1
+        if l < 60:
+            out.append(l << 2)
+        else:
+            out.append(61 << 2)
+            out += l.to_bytes(2, "little")
+        out += chunk
+        i += len(chunk)
+    return bytes(out)
+
+
+def _snappy_uncompress_py(data: bytes) -> bytes:
+    # varint header
+    shift = 0
+    declared = 0
+    i = 0
+    while True:
+        if i >= len(data):
+            raise ValueError("bad snappy header")
+        b = data[i]
+        i += 1
+        declared |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    out = bytearray()
+    while i < len(data):
+        tag = data[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:
+            l = tag >> 2
+            if l >= 60:
+                nb = l - 59
+                l = int.from_bytes(data[i : i + nb], "little")
+                i += nb
+            l += 1
+            out += data[i : i + l]
+            i += l
+        else:
+            if kind == 1:
+                length = 4 + ((tag >> 2) & 7)
+                offset = ((tag >> 5) << 8) | data[i]
+                i += 1
+            elif kind == 2:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[i : i + 2], "little")
+                i += 2
+            else:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[i : i + 4], "little")
+                i += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("corrupt snappy stream")
+            for _ in range(length):
+                out.append(out[-offset])
+    if len(out) != declared:
+        raise ValueError("snappy length mismatch")
+    return bytes(out)

@@ -1,0 +1,148 @@
+/* _lodestar_native: CPython bindings for the native codec/hash tier.
+ *
+ * sha256(data) -> 32B digest
+ * sha256_level(data: N*64 bytes) -> N*32 bytes   (one merkle level)
+ * xxh64(data, seed=0) -> int
+ * snappy_compress(data) -> bytes
+ * snappy_uncompress(data) -> bytes
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+void lodestar_sha256(const uint8_t *data, size_t len, uint8_t out[32]);
+void lodestar_sha256_level(const uint8_t *in, size_t n, uint8_t *out);
+uint64_t lodestar_xxh64(const uint8_t *data, size_t len, uint64_t seed);
+size_t lodestar_snappy_max_compressed(size_t n);
+size_t lodestar_snappy_compress(const uint8_t *src, size_t len, uint8_t *dst);
+int lodestar_snappy_uncompress(const uint8_t *src, size_t src_len,
+                               uint8_t *dst, size_t dst_len);
+
+static int get_varint_head(const uint8_t *src, Py_ssize_t len, uint32_t *out) {
+  uint32_t v = 0;
+  int shift = 0;
+  Py_ssize_t i = 0;
+  while (i < len && shift <= 28) {
+    uint8_t b = src[i++];
+    v |= (uint32_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return 0;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+static PyObject *py_sha256(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  uint8_t out[32];
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return NULL;
+  lodestar_sha256((const uint8_t *)buf.buf, (size_t)buf.len, out);
+  PyBuffer_Release(&buf);
+  return PyBytes_FromStringAndSize((const char *)out, 32);
+}
+
+static PyObject *py_sha256_level(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  PyObject *out;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return NULL;
+  if (buf.len % 64 != 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "input must be a multiple of 64 bytes");
+    return NULL;
+  }
+  out = PyBytes_FromStringAndSize(NULL, buf.len / 2);
+  if (out == NULL) {
+    PyBuffer_Release(&buf);
+    return NULL;
+  }
+  lodestar_sha256_level((const uint8_t *)buf.buf, (size_t)(buf.len / 64),
+                        (uint8_t *)PyBytes_AS_STRING(out));
+  PyBuffer_Release(&buf);
+  return out;
+}
+
+static PyObject *py_xxh64(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  unsigned long long seed = 0;
+  uint64_t h;
+  if (!PyArg_ParseTuple(args, "y*|K", &buf, &seed)) return NULL;
+  h = lodestar_xxh64((const uint8_t *)buf.buf, (size_t)buf.len, (uint64_t)seed);
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLongLong((unsigned long long)h);
+}
+
+static PyObject *py_snappy_compress(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  PyObject *out;
+  size_t max, n;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return NULL;
+  if ((uint64_t)buf.len > 0xffffffffu) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "input too large for snappy block");
+    return NULL;
+  }
+  max = lodestar_snappy_max_compressed((size_t)buf.len);
+  out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)max);
+  if (out == NULL) {
+    PyBuffer_Release(&buf);
+    return NULL;
+  }
+  n = lodestar_snappy_compress((const uint8_t *)buf.buf, (size_t)buf.len,
+                               (uint8_t *)PyBytes_AS_STRING(out));
+  PyBuffer_Release(&buf);
+  if (n == 0 && buf.len != 0) {
+    Py_DECREF(out);
+    PyErr_SetString(PyExc_MemoryError, "snappy compression failed");
+    return NULL;
+  }
+  if (_PyBytes_Resize(&out, (Py_ssize_t)n) < 0) return NULL;
+  return out;
+}
+
+static PyObject *py_snappy_uncompress(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  PyObject *out;
+  uint32_t declared;
+  int rc;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return NULL;
+  if (get_varint_head((const uint8_t *)buf.buf, buf.len, &declared) != 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "bad snappy header");
+    return NULL;
+  }
+  out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)declared);
+  if (out == NULL) {
+    PyBuffer_Release(&buf);
+    return NULL;
+  }
+  rc = lodestar_snappy_uncompress((const uint8_t *)buf.buf, (size_t)buf.len,
+                                  (uint8_t *)PyBytes_AS_STRING(out),
+                                  (size_t)declared);
+  PyBuffer_Release(&buf);
+  if (rc != 0) {
+    Py_DECREF(out);
+    PyErr_Format(PyExc_ValueError, "corrupt snappy stream (%d)", rc);
+    return NULL;
+  }
+  return out;
+}
+
+static PyMethodDef methods[] = {
+    {"sha256", py_sha256, METH_VARARGS, "SHA-256 digest"},
+    {"sha256_level", py_sha256_level, METH_VARARGS,
+     "Hash N 64-byte chunks into N 32-byte digests"},
+    {"xxh64", py_xxh64, METH_VARARGS, "XXH64 hash"},
+    {"snappy_compress", py_snappy_compress, METH_VARARGS, "snappy block compress"},
+    {"snappy_uncompress", py_snappy_uncompress, METH_VARARGS,
+     "snappy block uncompress"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef module = {PyModuleDef_HEAD_INIT, "_lodestar_native",
+                                    NULL, -1, methods};
+
+PyMODINIT_FUNC PyInit__lodestar_native(void) {
+  return PyModule_Create(&module);
+}

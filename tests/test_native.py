@@ -1,0 +1,92 @@
+"""Native tier tests: differential vs hashlib / known vectors / roundtrips.
+
+The native module replaces the reference's as-sha256, xxhash-wasm and
+snappyjs deps (SURVEY.md §2.3); these tests pin its behavior to the
+portable fallbacks and to published test vectors.
+"""
+
+import hashlib
+import os
+
+import pytest
+
+from lodestar_tpu import native
+
+
+def test_native_module_built():
+    # the toolchain is baked into the image; the extension must compile
+    assert native.HAVE_NATIVE, "native extension failed to build"
+
+
+def test_sha256_matches_hashlib():
+    for data in (b"", b"abc", b"x" * 63, b"y" * 64, b"z" * 1000, os.urandom(257)):
+        assert native.sha256(data) == hashlib.sha256(data).digest()
+
+
+def test_sha256_level_matches_pairwise():
+    data = os.urandom(64 * 9)
+    out = native.sha256_level(data)
+    assert len(out) == 32 * 9
+    for i in range(9):
+        assert (
+            out[32 * i : 32 * i + 32]
+            == hashlib.sha256(data[64 * i : 64 * i + 64]).digest()
+        )
+
+
+def test_xxh64_known_vectors():
+    # standard XXH64 reference vectors
+    assert native.xxh64(b"", 0) == 0xEF46DB3751D8E999
+    assert native.xxh64(b"a", 0) == 0xD24EC4F1A98C6E5B
+    assert native.xxh64(b"abc", 0) == 0x44BC2CF5AD770999
+    assert native.xxh64(b"", 1) == 0xD5AFBA1336A3BE4B
+
+
+def test_xxh64_native_matches_python():
+    for n in (0, 1, 3, 4, 7, 8, 15, 31, 32, 33, 63, 100, 1024):
+        data = os.urandom(n)
+        assert native.xxh64(data, 7) == native._xxh64_py(data, 7)
+
+
+def test_snappy_roundtrip():
+    cases = [
+        b"",
+        b"a",
+        b"hello hello hello hello hello hello",
+        b"\x00" * 100_000,
+        os.urandom(1000),
+        b"ab" * 40_000,
+    ]
+    for data in cases:
+        comp = native.snappy_compress(data)
+        assert native.snappy_uncompress(comp) == data
+        # compressible inputs must actually compress
+    rep = b"0123456789abcdef" * 4096
+    assert len(native.snappy_compress(rep)) < len(rep) // 4
+
+
+def test_snappy_cross_tier_roundtrip():
+    # native-compressed streams must decode with the pure-Python decoder
+    # and vice versa (same wire format)
+    data = b"the quick brown fox " * 500
+    assert native._snappy_uncompress_py(native.snappy_compress(data)) == data
+    assert native.snappy_uncompress(native._snappy_compress_py(data)) == data
+
+
+def test_snappy_rejects_corrupt():
+    comp = bytearray(native.snappy_compress(b"hello world, hello world"))
+    comp[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        native.snappy_uncompress(bytes(comp) + b"\x90\x90\x90\x90")
+
+
+def test_ssz_backend_install():
+    from lodestar_tpu.ssz import hashing
+
+    before = hashing.merkleize_chunks([b"\x01" * 32, b"\x02" * 32])
+    native.install_ssz_backend()
+    try:
+        after = hashing.merkleize_chunks([b"\x01" * 32, b"\x02" * 32])
+        assert before == after
+    finally:
+        hashing.set_hash_backend(hashing.hash_level)
