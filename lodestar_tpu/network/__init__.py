@@ -1,0 +1,7 @@
+"""Network layer (SURVEY.md §2.2 `beacon-node/src/network/`).
+
+Built bottom-up: gossip topic/encoding (native snappy + xxhash msg-ids),
+req/resp SSZ-snappy framing, validation queues. The libp2p transport
+equivalent arrives as an asyncio TCP service; gossip/reqresp logic is
+transport-independent and tested over in-memory pipes.
+"""

@@ -1,0 +1,195 @@
+"""Gossip layer tests: topics, msg-id functions, and the attestation /
+block validation ladders (reference: network/gossip unit tests +
+chain/validation unit tests)."""
+
+import hashlib
+
+import pytest
+
+from lodestar_tpu import native
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain
+from lodestar_tpu.chain.validation import (
+    GossipAction,
+    compute_subnet_for_attestation,
+    validate_gossip_attestation,
+    validate_gossip_block,
+)
+from lodestar_tpu.config.beacon_config import (
+    BeaconConfig,
+    ChainForkConfig,
+    compute_signing_root,
+)
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.network.gossip import (
+    GossipTopic,
+    GossipType,
+    compute_msg_id,
+    decode_message,
+    encode_message,
+    fast_msg_id,
+    parse_topic,
+    stringify_topic,
+)
+from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.types import get_types
+
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+
+def test_topic_roundtrip():
+    digest = b"\x01\x02\x03\x04"
+    t1 = GossipTopic(GossipType.beacon_block, digest)
+    s1 = stringify_topic(t1)
+    assert s1 == "/eth2/01020304/beacon_block/ssz_snappy"
+    assert parse_topic(s1) == t1
+
+    t2 = GossipTopic(GossipType.beacon_attestation, digest, subnet=13)
+    s2 = stringify_topic(t2)
+    assert "_13/" in s2
+    assert parse_topic(s2) == t2
+
+    with pytest.raises(ValueError):
+        stringify_topic(GossipTopic(GossipType.beacon_attestation, digest))
+    with pytest.raises(ValueError):
+        parse_topic("/eth1/01020304/beacon_block/ssz_snappy")
+
+
+def test_message_encoding_roundtrip_and_msg_ids():
+    payload = b"ssz bytes " * 100
+    wire = encode_message(payload)
+    assert decode_message(wire) == payload
+    assert isinstance(fast_msg_id(wire), int)
+
+    topic = "/eth2/01020304/beacon_block/ssz_snappy"
+    mid = compute_msg_id(topic, wire)
+    assert len(mid) == 20
+    # spec formula reproduced independently
+    expected = hashlib.sha256(
+        b"\x01\x00\x00\x00"
+        + len(topic.encode()).to_bytes(8, "little")
+        + topic.encode()
+        + payload
+    ).digest()[:20]
+    assert mid == expected
+    # invalid snappy falls back to the INVALID domain over raw data
+    bad_wire = b"\xff\xff\xff\xff\xff"
+    mid_bad = compute_msg_id(topic, bad_wire)
+    expected_bad = hashlib.sha256(
+        b"\x00\x00\x00\x00"
+        + len(topic.encode()).to_bytes(8, "little")
+        + topic.encode()
+        + bad_wire
+    ).digest()[:20]
+    assert mid_bad == expected_bad
+
+
+@pytest.fixture(scope="module")
+def chain_setup():
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    chain = BeaconChain(config, types, state)
+    chain.clock.set_slot(1)
+    return config, types, chain
+
+
+def _make_single_attestation(config, types, chain, slot=0, flip_sig=False):
+    """A single-bit gossip attestation by the first member of committee 0."""
+    cached = chain.head_state
+    ctx = cached.epoch_ctx
+    epoch = slot // SPE
+    committee = ctx.get_beacon_committee(slot, 0)
+    head_root = chain.head_root
+    data = types.AttestationData(
+        slot=slot,
+        index=0,
+        beacon_block_root=head_root,
+        source=cached.state.current_justified_checkpoint.copy(),
+        target=types.Checkpoint(epoch=epoch, root=head_root),
+    )
+    domain = config.get_domain(DOMAIN_BEACON_ATTESTER, slot, epoch)
+    root = compute_signing_root(data.hash_tree_root(), domain)
+    signer = int(committee[0])
+    sk = bls.interop_secret_key(signer + (99 if flip_sig else 0))
+    bits = [False] * len(committee)
+    bits[0] = True
+    return types.Attestation(
+        aggregation_bits=bits, data=data, signature=sk.sign(root).to_bytes()
+    ), signer
+
+
+def test_validate_attestation_accept_then_duplicate(chain_setup):
+    config, types, chain = chain_setup
+    att, signer = _make_single_attestation(config, types, chain)
+    subnet = compute_subnet_for_attestation(
+        chain.head_state.epoch_ctx, att.data.slot, 0, MINIMAL
+    )
+    res = validate_gossip_attestation(chain, types, att, subnet)
+    assert res.action == GossipAction.ACCEPT, res.reason
+    assert res.attesting_index == signer
+    # same attester again → IGNORE (seen cache)
+    res2 = validate_gossip_attestation(chain, types, att, subnet)
+    assert res2.action == GossipAction.IGNORE
+
+
+def test_validate_attestation_reject_paths(chain_setup):
+    config, types, chain = chain_setup
+    att, _ = _make_single_attestation(config, types, chain)
+    subnet = compute_subnet_for_attestation(
+        chain.head_state.epoch_ctx, att.data.slot, 0, MINIMAL
+    )
+
+    # two bits set → REJECT
+    att2 = att.copy()
+    bits = list(att2.aggregation_bits)
+    bits[1] = True
+    att2.aggregation_bits = bits
+    assert (
+        validate_gossip_attestation(chain, types, att2, subnet).action
+        == GossipAction.REJECT
+    )
+
+    # wrong subnet → REJECT
+    att3, _ = _make_single_attestation(config, types, chain)
+    assert (
+        validate_gossip_attestation(chain, types, att3, subnet + 1).action
+        == GossipAction.REJECT
+    )
+
+    # unknown head block → IGNORE
+    att4, _ = _make_single_attestation(config, types, chain)
+    att4.data.beacon_block_root = b"\x77" * 32
+    assert (
+        validate_gossip_attestation(chain, types, att4, subnet).action
+        == GossipAction.IGNORE
+    )
+
+    # bad signature → REJECT (use a different committee member so the seen
+    # cache doesn't IGNORE first)
+    att5, _ = _make_single_attestation(config, types, chain, flip_sig=True)
+    bits = [False] * len(att5.aggregation_bits)
+    bits[1] = True
+    att5.aggregation_bits = bits
+    assert (
+        validate_gossip_attestation(chain, types, att5, subnet).action
+        == GossipAction.REJECT
+    )
+
+
+def test_validate_block_ladder(chain_setup):
+    config, types, chain = chain_setup
+    # unknown parent → IGNORE
+    blk = types.SignedBeaconBlock()
+    blk.message.slot = 1
+    blk.message.parent_root = b"\x55" * 32
+    assert validate_gossip_block(chain, types, blk).action == GossipAction.IGNORE
+    # future slot → IGNORE
+    blk2 = types.SignedBeaconBlock()
+    blk2.message.slot = 99
+    assert validate_gossip_block(chain, types, blk2).action == GossipAction.IGNORE

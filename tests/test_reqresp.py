@@ -1,0 +1,134 @@
+"""Req/Resp tests: snappy framing, wire codec, protocol handlers end-to-end
+(reference: reqresp encodingStrategies unit tests + handler e2e)."""
+
+import os
+
+import pytest
+
+from lodestar_tpu.network.reqresp import (
+    PROTOCOLS,
+    Protocol,
+    RespCode,
+    decode_request,
+    decode_response_chunks,
+    encode_request,
+    encode_response_chunk,
+    encode_error_chunk,
+    protocol_id,
+)
+from lodestar_tpu.network.reqresp.protocols import parse_protocol_id
+from lodestar_tpu.network.reqresp.snappy_frames import (
+    compress_frames,
+    crc32c,
+    decompress_frames,
+)
+
+
+def test_crc32c_vectors():
+    # RFC 3720 / known CRC32C vectors
+    assert crc32c(b"") == 0x00000000
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_snappy_framing_roundtrip():
+    for data in (b"", b"x", b"hello " * 1000, os.urandom(200_000)):
+        framed = compress_frames(data)
+        assert decompress_frames(framed) == data
+    with pytest.raises(ValueError):
+        decompress_frames(b"not a stream")
+    framed = bytearray(compress_frames(b"payload payload payload"))
+    framed[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        decompress_frames(bytes(framed))
+
+
+def test_request_codec_roundtrip():
+    payload = os.urandom(500)
+    wire = encode_request(payload)
+    assert decode_request(wire) == payload
+
+
+def test_response_chunks_roundtrip():
+    chunks = [os.urandom(100), b"", os.urandom(70000)]
+    wire = b"".join(encode_response_chunk(c) for c in chunks)
+    wire += encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "pruned")
+    decoded = decode_response_chunks(wire)
+    assert [c for _, c in decoded[:3]] == chunks
+    assert all(code == RespCode.SUCCESS for code, _ in decoded[:3])
+    assert decoded[3][0] == RespCode.RESOURCE_UNAVAILABLE
+    assert decoded[3][1] == b"pruned"
+
+
+def test_protocol_ids():
+    pid = protocol_id(Protocol.BeaconBlocksByRange, 2)
+    assert pid == "/eth2/beacon_chain/req/beacon_blocks_by_range/2/ssz_snappy"
+    assert parse_protocol_id(pid) == (Protocol.BeaconBlocksByRange, 2)
+    assert len(PROTOCOLS) == 10
+
+
+def test_handlers_against_live_chain(tmp_path):
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.network.reqresp.handlers import ReqRespHandlers
+    from lodestar_tpu.params.presets import MINIMAL
+    from lodestar_tpu.state_transition import interop_genesis_state
+    from lodestar_tpu.types import get_types
+    from tests.test_chain import _attest_head, _sign_block, _sk
+    from lodestar_tpu.state_transition.block import _epoch_signing_root
+    from lodestar_tpu.params import DOMAIN_RANDAO
+    from lodestar_tpu.state_transition import process_slots
+
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    chain = BeaconChain(config, types, state)
+    blocks = []
+    for slot in range(1, 5):
+        chain.clock.set_slot(slot)
+        trial = chain.head_state.copy()
+        if slot > trial.state.slot:
+            process_slots(trial, types, slot)
+        proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+        reveal = _sk(proposer).sign(
+            _epoch_signing_root(0, config.get_domain(DOMAIN_RANDAO, slot))
+        ).to_bytes()
+        block = chain.produce_block(slot, randao_reveal=reveal)
+        signed = _sign_block(config, types, block)
+        chain.process_block(signed, verify_signatures=False)
+        blocks.append(signed)
+
+    handlers = ReqRespHandlers(config, types, chain)
+
+    # status reflects head
+    status_wire = handlers.on_status(None)
+    (code, payload), = decode_response_chunks(status_wire)
+    assert code == RespCode.SUCCESS
+    status = types.Status.deserialize(payload)
+    assert status.head_slot == 4
+    assert bytes(status.head_root) == chain.head_root
+
+    # by-range returns the produced blocks in slot order
+    wire = handlers.on_beacon_blocks_by_range(1, 10)
+    chunks = decode_response_chunks(wire)
+    got = [types.SignedBeaconBlock.deserialize(p).message.slot for _, p in chunks]
+    assert got == [1, 2, 3, 4]
+
+    # by-root finds a specific block
+    root = blocks[2].message.hash_tree_root()
+    wire2 = handlers.on_beacon_blocks_by_root([root, b"\x00" * 32])
+    chunks2 = decode_response_chunks(wire2)
+    assert len(chunks2) == 1
+    assert (
+        types.SignedBeaconBlock.deserialize(chunks2[0][1]).message.hash_tree_root()
+        == root
+    )
+
+    # invalid range → error chunk
+    err = handlers.on_beacon_blocks_by_range(0, 0)
+    (code, msg), = decode_response_chunks(err)
+    assert code == RespCode.INVALID_REQUEST
