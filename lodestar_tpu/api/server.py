@@ -1,0 +1,83 @@
+"""REST server over the route table (stdlib http.server).
+
+Reference: `api/src/utils/server/genericJsonServer.ts` + fastify
+registration in `beacon-node/src/api/rest/` — here a ThreadingHTTPServer
+binds `routes.API_ROUTES` to a `BeaconApiImpl` by operation id.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from .impl import ApiError
+from .routes import match_route
+
+
+class BeaconApiServer:
+    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0):
+        self.impl = impl
+        impl_ref = impl
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                route, params = match_route(method, parsed.path)
+                if route is None:
+                    return self._send(404, {"message": "route not found"})
+                query = dict(parse_qsl(parsed.query))
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError:
+                        return self._send(400, {"message": "invalid JSON body"})
+                handler = getattr(impl_ref, route.operation_id, None)
+                if handler is None:
+                    return self._send(501, {"message": "not implemented"})
+                try:
+                    result = handler(params, query, body)
+                except ApiError as e:
+                    return self._send(e.status, {"message": e.message})
+                except Exception as e:
+                    return self._send(500, {"message": f"internal error: {e}"})
+                if result is None:
+                    return self._send(200, {})
+                return self._send(200, {"data": result})
+
+            def _send(self, status: int, obj):
+                payload = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

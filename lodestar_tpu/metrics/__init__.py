@@ -1,0 +1,12 @@
+"""Metrics registry + /metrics HTTP endpoint (SURVEY.md §2.2 `metrics/`).
+
+Reference: prom-client registry with ~200 lodestar metrics
+(`metrics/metrics/lodestar.ts`), interop beacon metrics, ValidatorMonitor,
+HTTP server (`metrics/server/http.ts`). Here: a dependency-free registry
+emitting the Prometheus text exposition format, the beacon/lodestar metric
+sets used by the services built so far, and the same HTTP surface.
+"""
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .beacon import create_beacon_metrics  # noqa: F401
+from .server import MetricsServer  # noqa: F401

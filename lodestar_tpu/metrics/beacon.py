@@ -1,0 +1,59 @@
+"""Beacon metric set: the interop-standard gauges plus the lodestar-
+specific BLS-pool/block-processor metrics our services emit.
+
+Reference: `metrics/metrics/beacon.ts` (official interop names) and
+`metrics/metrics/lodestar.ts` (lodestar_* namespace; blsThreadPool.* at
+:412 — mapped here to the device-verifier equivalents).
+"""
+
+from __future__ import annotations
+
+from .registry import MetricsRegistry
+
+
+def create_beacon_metrics(registry: MetricsRegistry | None = None):
+    r = registry if registry is not None else MetricsRegistry()
+
+    class M:
+        pass
+
+    m = M()
+    m.registry = r
+    # interop-standard (beacon.ts)
+    m.head_slot = r.gauge("beacon_head_slot", "slot of the chain head")
+    m.finalized_epoch = r.gauge("beacon_finalized_epoch", "latest finalized epoch")
+    m.current_justified_epoch = r.gauge(
+        "beacon_current_justified_epoch", "current justified epoch"
+    )
+    m.proposed_blocks_total = r.counter(
+        "beacon_blocks_proposed_total", "blocks proposed by this node"
+    )
+    m.processed_blocks_total = r.counter(
+        "beacon_processed_blocks_total", "blocks imported"
+    )
+    m.gossip_attestations_total = r.counter(
+        "beacon_gossip_attestation_total", "gossip attestations by outcome",
+        label_names=("outcome",),
+    )
+    # lodestar_* equivalents (lodestar.ts) — the device verifier pool
+    m.bls_batches_total = r.counter(
+        "lodestar_bls_verifier_batches_total", "batched verification dispatches"
+    )
+    m.bls_sets_total = r.counter(
+        "lodestar_bls_verifier_sets_total", "signature sets verified"
+    )
+    m.bls_batch_fallbacks_total = r.counter(
+        "lodestar_bls_verifier_batch_fallbacks_total",
+        "batches that failed and fell back to per-set verdicts",
+    )
+    m.bls_verify_seconds = r.histogram(
+        "lodestar_bls_verifier_seconds", "device batch verification latency"
+    )
+    m.block_import_seconds = r.histogram(
+        "lodestar_block_processor_import_seconds", "block import pipeline latency"
+    )
+    m.state_cache_size = r.gauge("lodestar_state_cache_size", "hot states cached")
+    m.fork_choice_nodes = r.gauge(
+        "lodestar_fork_choice_nodes", "proto-array node count"
+    )
+    return m

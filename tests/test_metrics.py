@@ -1,0 +1,54 @@
+"""Metrics tests: registry semantics, exposition format, HTTP endpoint."""
+
+import urllib.request
+
+from lodestar_tpu.metrics import MetricsRegistry, MetricsServer, create_beacon_metrics
+
+
+def test_counter_gauge_histogram():
+    r = MetricsRegistry()
+    c = r.counter("requests_total", "reqs", label_names=("route",))
+    c.inc(route="a")
+    c.inc(2, route="a")
+    c.inc(route="b")
+    assert c.value(route="a") == 3
+    g = r.gauge("head_slot", "slot")
+    g.set(42)
+    h = r.histogram("latency_seconds", "lat", buckets=(0.1, 1, 10))
+    h.observe(0.05)
+    h.observe(5)
+    text = r.expose()
+    assert 'requests_total{route="a"} 3' in text
+    assert "head_slot 42" in text
+    assert 'latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'latency_seconds_bucket{le="10.0"} 2' in text
+    assert 'latency_seconds_bucket{le="+Inf"} 2' in text
+    assert "latency_seconds_count 2" in text
+    assert "# TYPE requests_total counter" in text
+
+
+def test_histogram_timer():
+    r = MetricsRegistry()
+    h = r.histogram("op_seconds", "op")
+    with h.time():
+        pass
+    assert h._totals[()] == 1
+
+
+def test_beacon_metric_set_and_http_server():
+    m = create_beacon_metrics()
+    m.head_slot.set(7)
+    m.bls_sets_total.inc(128)
+    m.gossip_attestations_total.inc(outcome="ACCEPT")
+    server = MetricsServer(m.registry, port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics"
+        ) as resp:
+            body = resp.read().decode()
+        assert "beacon_head_slot 7" in body
+        assert "lodestar_bls_verifier_sets_total 128" in body
+        assert 'beacon_gossip_attestation_total{outcome="ACCEPT"} 1' in body
+    finally:
+        server.close()
