@@ -1,0 +1,12 @@
+"""Sync (SURVEY.md §2.2 `sync/`): range sync, unknown-block sync.
+
+Reference: `sync/sync.ts` orchestrator — RangeSync (per-target SyncChains
+of epoch batches with peer balancing, `range/`), UnknownBlockSync
+(fetch-by-root for unknown parents, `unknownBlock.ts`), BackfillSync.
+Peers are anything speaking the req/resp surface (`IPeer`), so tests wire
+two in-process nodes through the real wire codec.
+"""
+
+from .range_sync import BatchStatus, RangeSync, SyncBatch  # noqa: F401
+from .unknown_block import UnknownBlockSync  # noqa: F401
+from .peer import IPeer, LocalPeer  # noqa: F401

