@@ -1,0 +1,10 @@
+"""Eth1 deposit tracking + eth1-data voting (SURVEY.md §2.2 `eth1/`).
+
+Reference: `eth1/` — deposit-contract follower (`provider/eth1Provider.ts`
+JSON-RPC), `eth1DepositsCache` / `eth1DataCache`, eth1-data vote picking
+(`utils/eth1Vote.ts`-equivalent majority rule), deposit-root tracking.
+The provider here is an interface; the dev tier uses `Eth1ProviderMock`
+(the reference dev path injects deposits the same way).
+"""
+
+from .deposit_tracker import Eth1DepositTracker, Eth1ProviderMock  # noqa: F401

@@ -1,0 +1,15 @@
+"""Execution layer client (SURVEY.md §2.2 `execution/`).
+
+Reference: `execution/engine/` — `IExecutionEngine` (interface.ts),
+JSON-RPC HTTP client with JWT auth (http.ts: engine_newPayloadV1,
+engine_forkchoiceUpdatedV1, engine_getPayloadV1), and the complete
+in-memory mock EL (mock.ts:31) used by tests/sim.
+"""
+
+from .engine import (  # noqa: F401
+    ExecutePayloadStatus,
+    ExecutionEngineHttp,
+    ExecutionEngineMock,
+    IExecutionEngine,
+    PayloadAttributes,
+)

@@ -1,0 +1,207 @@
+"""Engine API: interface, in-memory mock EL, JSON-RPC client.
+
+Reference: `execution/engine/interface.ts` (IExecutionEngine),
+`engine/mock.ts:31` (ExecutionEngineMock — a full fake EL maintaining a
+block tree with TTD logic), `engine/http.ts` (JSON-RPC with
+jwt-simple HS256 auth).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Protocol
+
+from ..ssz.hashing import sha256
+
+
+class ExecutePayloadStatus(str, Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+    ELERROR = "ELERROR"
+    UNAVAILABLE = "UNAVAILABLE"
+
+
+@dataclass
+class PayloadAttributes:
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes
+
+
+class IExecutionEngine(Protocol):
+    def notify_new_payload(self, payload) -> ExecutePayloadStatus: ...
+
+    def notify_forkchoice_update(
+        self,
+        head_block_hash: bytes,
+        safe_block_hash: bytes,
+        finalized_block_hash: bytes,
+        attributes: PayloadAttributes | None = None,
+    ) -> str | None: ...
+
+    def get_payload(self, payload_id: str): ...
+
+
+@dataclass
+class _MockPayload:
+    block_hash: bytes
+    parent_hash: bytes
+    block_number: int
+    timestamp: int
+    prev_randao: bytes
+    fee_recipient: bytes
+    transactions: list = field(default_factory=list)
+
+
+class ExecutionEngineMock:
+    """In-memory EL: payload tree + building sessions (reference mock.ts).
+
+    Used by the dev chain and sim tests exactly like the reference uses
+    ExecutionEngineMock — valid unless told otherwise."""
+
+    def __init__(self, genesis_block_hash: bytes = b"\x00" * 32):
+        self.head: bytes = genesis_block_hash
+        self.finalized: bytes = genesis_block_hash
+        self.payloads: dict[bytes, _MockPayload] = {
+            genesis_block_hash: _MockPayload(
+                block_hash=genesis_block_hash,
+                parent_hash=b"\x00" * 32,
+                block_number=0,
+                timestamp=0,
+                prev_randao=b"\x00" * 32,
+                fee_recipient=b"\x00" * 20,
+            )
+        }
+        self._building: dict[str, _MockPayload] = {}
+        self._payload_id = 0
+        # test hook: mark hashes invalid (reference mock supports error
+        # injection for invalid-payload paths)
+        self.invalid_hashes: set[bytes] = set()
+
+    def notify_new_payload(self, payload) -> ExecutePayloadStatus:
+        if payload.block_hash in self.invalid_hashes:
+            return ExecutePayloadStatus.INVALID
+        if payload.parent_hash not in self.payloads:
+            return ExecutePayloadStatus.SYNCING
+        parent = self.payloads[payload.parent_hash]
+        if payload.block_number != parent.block_number + 1:
+            return ExecutePayloadStatus.INVALID
+        self.payloads[payload.block_hash] = payload
+        return ExecutePayloadStatus.VALID
+
+    def notify_forkchoice_update(
+        self,
+        head_block_hash: bytes,
+        safe_block_hash: bytes,
+        finalized_block_hash: bytes,
+        attributes: PayloadAttributes | None = None,
+    ) -> str | None:
+        if head_block_hash not in self.payloads:
+            return None
+        self.head = head_block_hash
+        self.finalized = finalized_block_hash
+        if attributes is None:
+            return None
+        parent = self.payloads[head_block_hash]
+        self._payload_id += 1
+        payload_id = f"0x{self._payload_id:016x}"
+        block_hash = sha256(
+            head_block_hash + attributes.timestamp.to_bytes(8, "little")
+        )
+        self._building[payload_id] = _MockPayload(
+            block_hash=block_hash,
+            parent_hash=head_block_hash,
+            block_number=parent.block_number + 1,
+            timestamp=attributes.timestamp,
+            prev_randao=attributes.prev_randao,
+            fee_recipient=attributes.suggested_fee_recipient,
+        )
+        return payload_id
+
+    def get_payload(self, payload_id: str) -> _MockPayload:
+        payload = self._building.pop(payload_id, None)
+        if payload is None:
+            raise ValueError(f"unknown payload id {payload_id}")
+        return payload
+
+
+def _jwt_hs256(secret: bytes) -> str:
+    """Engine-API JWT: HS256, iat claim (reference uses jwt-simple)."""
+    b64 = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=")
+    header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = b64(json.dumps({"iat": int(time.time())}).encode())
+    signing_input = header + b"." + claims
+    sig = b64(hmac.new(secret, signing_input, hashlib.sha256).digest())
+    return (signing_input + b"." + sig).decode()
+
+
+class ExecutionEngineHttp:
+    """JSON-RPC engine client (engine_newPayloadV1 / forkchoiceUpdatedV1 /
+    getPayloadV1) with fresh JWT per request (reference http.ts)."""
+
+    def __init__(self, host: str, port: int, jwt_secret: bytes, timeout: float = 8.0):
+        self.host = host
+        self.port = port
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    def _call(self, method: str, params: list):
+        import http.client
+
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request(
+                "POST",
+                "/",
+                body=body,
+                headers={
+                    "Content-Type": "application/json",
+                    "Authorization": f"Bearer {_jwt_hs256(self.jwt_secret)}",
+                },
+            )
+            resp = json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+        if "error" in resp:
+            raise RuntimeError(f"{method}: {resp['error']}")
+        return resp["result"]
+
+    def notify_new_payload(self, payload_json: dict) -> ExecutePayloadStatus:
+        result = self._call("engine_newPayloadV1", [payload_json])
+        return ExecutePayloadStatus(result["status"])
+
+    def notify_forkchoice_update(
+        self, head: bytes, safe: bytes, finalized: bytes, attributes=None
+    ):
+        fc_state = {
+            "headBlockHash": "0x" + head.hex(),
+            "safeBlockHash": "0x" + safe.hex(),
+            "finalizedBlockHash": "0x" + finalized.hex(),
+        }
+        attrs = None
+        if attributes is not None:
+            attrs = {
+                "timestamp": hex(attributes.timestamp),
+                "prevRandao": "0x" + attributes.prev_randao.hex(),
+                "suggestedFeeRecipient": "0x" + attributes.suggested_fee_recipient.hex(),
+            }
+        result = self._call("engine_forkchoiceUpdatedV1", [fc_state, attrs])
+        payload_id = result.get("payloadId")
+        return payload_id
+
+    def get_payload(self, payload_id: str) -> dict:
+        return self._call("engine_getPayloadV1", [payload_id])
