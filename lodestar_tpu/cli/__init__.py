@@ -1,0 +1,8 @@
+"""CLI (SURVEY.md §2.1 `cli`): `python -m lodestar_tpu.cli <cmd>`.
+
+Reference: `packages/cli` yargs commands — `dev` (single-process local
+testnet: `cli/src/cmds/dev`), `beacon`, `validator`. The `dev` command is
+the minimum end-to-end slice (SURVEY.md §7): interop genesis, in-process
+validators, block production + import with batched signature verification,
+REST API + metrics servers, finality tracking.
+"""

@@ -1,0 +1,23 @@
+"""`python -m lodestar_tpu.cli` — command dispatcher (reference:
+cli/src/cli.ts yargs tree)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .dev import add_dev_parser
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lodestar-tpu", description="TPU-native beacon chain framework"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    add_dev_parser(sub)
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
