@@ -71,8 +71,8 @@ def run_dev(args) -> int:
             chain.clock.set_slot(slot)
             t0 = time.perf_counter()
             signed = service.propose_block_if_due(slot)
+            dt = time.perf_counter() - t0  # produce+import only
             service.attest_if_due(slot)
-            dt = time.perf_counter() - t0
             metrics.head_slot.set(chain.head_state.state.slot)
             metrics.current_justified_epoch.set(chain.justified_checkpoint[0])
             metrics.finalized_epoch.set(chain.finalized_checkpoint[0])

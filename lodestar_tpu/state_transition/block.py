@@ -452,8 +452,14 @@ def process_operations(cached, types, body, verify_signatures: bool = True) -> N
         process_proposer_slashing(cached, op, verify_signatures)
     for op in body.attester_slashings:
         process_attester_slashing(cached, op, verify_signatures)
-    for op in body.attestations:
-        process_attestation(cached, types, op, verify_signatures)
+    if cached.is_altair:
+        from .altair import process_attestation_altair
+
+        for op in body.attestations:
+            process_attestation_altair(cached, types, op, verify_signatures)
+    else:
+        for op in body.attestations:
+            process_attestation(cached, types, op, verify_signatures)
     for op in body.deposits:
         process_deposit(cached, types, op)
     for op in body.voluntary_exits:
@@ -465,3 +471,7 @@ def process_block(cached, types, block, verify_signatures: bool = True) -> None:
     process_randao(cached, block.body, verify_signatures)
     process_eth1_data(cached, types, block.body)
     process_operations(cached, types, block.body, verify_signatures)
+    if cached.is_altair and hasattr(block.body, "sync_aggregate"):
+        from .altair import process_sync_aggregate
+
+        process_sync_aggregate(cached, block.body.sync_aggregate, verify_signatures)

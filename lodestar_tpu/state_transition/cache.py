@@ -216,8 +216,53 @@ class CachedBeaconState:
         self.preset = preset if preset is not None else config.preset
         self.state = state
         self.flat = FlatValidators(state)
+        # altair+: participation flags + inactivity scores mirror into flat
+        # arrays (same pattern as FlatValidators)
+        self.is_altair = hasattr(state, "previous_epoch_participation")
+        if hasattr(state, "latest_execution_payload_header"):
+            # bellatrix/capella states would silently run altair-only
+            # processing (wrong slashing/inactivity constants, no payload
+            # handling) — fail loudly until those forks are implemented
+            raise NotImplementedError(
+                "bellatrix/capella state transition not implemented yet"
+            )
+        if self.is_altair:
+            self.previous_participation = np.array(
+                state.previous_epoch_participation, np.uint8
+            )
+            self.current_participation = np.array(
+                state.current_epoch_participation, np.uint8
+            )
+            self.inactivity_scores = np.array(state.inactivity_scores, U64)
         self.epoch_ctx = EpochContext(config, self.preset)
         self.epoch_ctx.load_state(state, self.flat)
+
+    def sync_flat(self) -> None:
+        """Write every flat-array column back into the SSZ state (called
+        before any hash_tree_root)."""
+        self.flat.sync_to_state(self.state)
+        if self.is_altair:
+            n = len(self.flat)
+            # new validators since load: extend participation columns
+            for name in ("previous_participation", "current_participation"):
+                arr = getattr(self, name)
+                if len(arr) < n:
+                    setattr(
+                        self,
+                        name,
+                        np.concatenate([arr, np.zeros(n - len(arr), np.uint8)]),
+                    )
+            if len(self.inactivity_scores) < n:
+                self.inactivity_scores = np.concatenate(
+                    [self.inactivity_scores, np.zeros(n - len(self.inactivity_scores), U64)]
+                )
+            self.state.previous_epoch_participation = [
+                int(x) for x in self.previous_participation
+            ]
+            self.state.current_epoch_participation = [
+                int(x) for x in self.current_participation
+            ]
+            self.state.inactivity_scores = [int(x) for x in self.inactivity_scores]
 
     @property
     def slot(self) -> int:
@@ -232,4 +277,5 @@ class CachedBeaconState:
         return max(GENESIS_EPOCH, self.current_epoch - 1)
 
     def copy(self) -> "CachedBeaconState":
+        self.sync_flat()  # flat arrays may be dirty mid-pipeline
         return CachedBeaconState(self.config, self.state.copy(), self.preset)

@@ -116,6 +116,43 @@ def voluntary_exit_signature_set(cached, signed_exit) -> bls.SignatureSet:
     )
 
 
+def sync_aggregate_signature_set(cached, block) -> bls.SignatureSet | None:
+    """Sync-committee aggregate over the previous slot's block root
+    (reference: syncCommittee signature set in signatureSets/). None when
+    no bits are set — the mandatory infinity-signature rule for empty
+    participation is structural and enforced inline by
+    process_sync_aggregate regardless of signature verification."""
+    from ..params import DOMAIN_SYNC_COMMITTEE
+
+    state, p = cached.state, cached.preset
+    aggregate = block.body.sync_aggregate
+    bits = list(aggregate.sync_committee_bits)
+    participants = [
+        bytes(pk)
+        for pk, b in zip(state.current_sync_committee.pubkeys, bits)
+        if b
+    ]
+    if not participants:
+        return None
+    previous_slot = max(block.slot, 1) - 1
+    domain = cached.config.get_domain(
+        DOMAIN_SYNC_COMMITTEE,
+        previous_slot,
+        util.compute_epoch_at_slot(previous_slot, p.SLOTS_PER_EPOCH),
+    )
+    root = bytes(
+        state.block_roots[previous_slot % p.SLOTS_PER_HISTORICAL_ROOT]
+    )
+    agg = bls.aggregate_pubkeys(
+        [bls.PublicKey.from_bytes(pk, validate=False) for pk in participants]
+    )
+    return bls.SignatureSet(
+        pubkey=agg,
+        message=compute_signing_root(root, domain),
+        signature=bytes(aggregate.sync_committee_signature),
+    )
+
+
 def get_block_signature_sets(
     cached, types, signed_block, include_proposer: bool = True
 ) -> list[bls.SignatureSet]:
@@ -136,4 +173,8 @@ def get_block_signature_sets(
         sets.append(attestation_signature_set(cached, types, att))
     for op in body.voluntary_exits:
         sets.append(voluntary_exit_signature_set(cached, op))
+    if cached.is_altair and hasattr(body, "sync_aggregate"):
+        sync_set = sync_aggregate_signature_set(cached, block)
+        if sync_set is not None:
+            sets.append(sync_set)
     return sets

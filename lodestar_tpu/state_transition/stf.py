@@ -12,6 +12,15 @@ from .block import BlockProcessingError, process_block
 from .epoch import process_epoch
 
 
+def _process_epoch_for_fork(cached, types) -> None:
+    if cached.is_altair:
+        from .altair import process_epoch_altair
+
+        process_epoch_altair(cached, types)
+    else:
+        process_epoch(cached, types)
+
+
 def process_slot(cached, types) -> None:
     state, p = cached.state, cached.preset
     prev_state_root = state.hash_tree_root()
@@ -32,8 +41,8 @@ def process_slots(cached, types, slot: int) -> None:
     while state.slot < slot:
         process_slot(cached, types)
         if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
-            process_epoch(cached, types)
-            cached.flat.sync_to_state(state)
+            _process_epoch_for_fork(cached, types)
+            cached.sync_flat()
             state.slot += 1
             cached.epoch_ctx.rotate_epoch(state, cached.flat)
         else:
@@ -54,7 +63,7 @@ def state_transition(
     if block.slot > cached.state.slot:
         process_slots(cached, types, block.slot)
     process_block(cached, types, block, verify_signatures)
-    cached.flat.sync_to_state(cached.state)
+    cached.sync_flat()
     if verify_state_root:
         got = cached.state.hash_tree_root()
         if got != bytes(block.state_root):

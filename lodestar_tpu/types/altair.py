@@ -171,4 +171,8 @@ def make_types(p: Preset, phase0: SimpleNamespace) -> SimpleNamespace:
         phase0.Metadata.fields + [("syncnets", BitVectorType(SYNC_COMMITTEE_SUBNET_COUNT))],
     )
 
-    return SimpleNamespace(**{k: v for k, v in locals().items() if isinstance(v, type)})
+    # inherit unchanged phase0 containers, then overlay the altair ones
+    # (reference: ssz.altair re-exports phase0 types it doesn't redefine)
+    merged = {k: v for k, v in vars(phase0).items() if isinstance(v, type)}
+    merged.update({k: v for k, v in locals().items() if isinstance(v, type)})
+    return SimpleNamespace(**merged)

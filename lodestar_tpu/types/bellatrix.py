@@ -83,4 +83,6 @@ def make_types(p: Preset, phase0: SimpleNamespace, altair: SimpleNamespace) -> S
         ],
     )
 
-    return SimpleNamespace(**{k: v for k, v in locals().items() if isinstance(v, type)})
+    merged = {k: v for k, v in vars(altair).items() if isinstance(v, type)}
+    merged.update({k: v for k, v in locals().items() if isinstance(v, type)})
+    return SimpleNamespace(**merged)

@@ -113,4 +113,6 @@ def make_types(
         ],
     )
 
-    return SimpleNamespace(**{k: v for k, v in locals().items() if isinstance(v, type)})
+    merged = {k: v for k, v in vars(bellatrix).items() if isinstance(v, type)}
+    merged.update({k: v for k, v in locals().items() if isinstance(v, type)})
+    return SimpleNamespace(**merged)

@@ -1,0 +1,258 @@
+"""Altair state-transition tests: fork upgrade, participation-flag
+accounting, sync aggregates, finality (reference analog: altair sanity +
+finality spec suites, fork transition tests)."""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.config.beacon_config import (
+    BeaconConfig,
+    ChainForkConfig,
+    compute_signing_root,
+)
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+)
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import (
+    CachedBeaconState,
+    interop_genesis_state,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
+from lodestar_tpu.state_transition.block import _epoch_signing_root
+from lodestar_tpu.types import get_types
+
+N = 16
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+
+def _sk(i):
+    return bls.interop_secret_key(i)
+
+
+@pytest.fixture(scope="module")
+def altair_genesis():
+    t = get_types(MINIMAL)
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    pre = interop_genesis_state(fork_config, t.phase0, N, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(pre.genesis_validators_root), MINIMAL
+    )
+    state = upgrade_state_to_altair(config, MINIMAL, pre, t.altair)
+    return config, t.altair, state
+
+
+def test_upgrade_to_altair(altair_genesis):
+    config, types, state = altair_genesis
+    assert bytes(state.fork.current_version) == config.ALTAIR_FORK_VERSION
+    assert len(state.previous_epoch_participation) == N
+    assert len(state.inactivity_scores) == N
+    assert len(state.current_sync_committee.pubkeys) == MINIMAL.SYNC_COMMITTEE_SIZE
+    assert state.current_sync_committee == state.next_sync_committee
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    assert cached.is_altair
+
+
+def _sync_aggregate(config, types, cached, signing_block_root: bytes, slot: int):
+    """Full-participation sync aggregate signing `signing_block_root` at
+    `slot`'s previous slot."""
+    prev_slot = max(slot, 1) - 1
+    domain = config.get_domain(
+        DOMAIN_SYNC_COMMITTEE, prev_slot, prev_slot // SPE
+    )
+    root = compute_signing_root(signing_block_root, domain)
+    pk_to_idx = cached.epoch_ctx.pubkey_to_index
+    sigs = [
+        _sk(pk_to_idx[bytes(pk)]).sign(root)
+        for pk in cached.state.current_sync_committee.pubkeys
+    ]
+    return types.SyncAggregate(
+        sync_committee_bits=[True] * MINIMAL.SYNC_COMMITTEE_SIZE,
+        sync_committee_signature=bls.aggregate_signatures(sigs).to_bytes(),
+    )
+
+
+def produce_altair_block(config, types, cached, slot, attestations, with_sync=True):
+    pre = cached.copy()
+    if slot > pre.state.slot:
+        process_slots(pre, types, slot)
+    proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+    sk = _sk(proposer)
+    parent_root = pre.state.latest_block_header.hash_tree_root()
+    body = types.BeaconBlockBody(
+        randao_reveal=sk.sign(
+            _epoch_signing_root(slot // SPE, config.get_domain(DOMAIN_RANDAO, slot))
+        ).to_bytes(),
+        eth1_data=pre.state.eth1_data.copy(),
+        attestations=attestations,
+    )
+    if with_sync:
+        body.sync_aggregate = _sync_aggregate(config, types, pre, parent_root, slot)
+    block = types.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=parent_root,
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    trial = pre.copy()
+    state_transition(
+        trial,
+        types,
+        types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
+        verify_state_root=False,
+        verify_signatures=False,
+    )
+    block.state_root = trial.state.hash_tree_root()
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, slot)
+    sig = sk.sign(compute_signing_root(block.hash_tree_root(), domain))
+    return types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+
+
+def produce_attestations(config, types, cached, head_root):
+    state = cached.state
+    slot = state.slot
+    epoch = slot // SPE
+    start = epoch * SPE
+    target_root = head_root if start == slot else bytes(
+        state.block_roots[start % MINIMAL.SLOTS_PER_HISTORICAL_ROOT]
+    )
+    atts = []
+    domain = config.get_domain(DOMAIN_BEACON_ATTESTER, slot, epoch)
+    for index in range(cached.epoch_ctx.get_committee_count_per_slot(epoch)):
+        committee = cached.epoch_ctx.get_beacon_committee(slot, index)
+        data = types.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint.copy(),
+            target=types.Checkpoint(epoch=epoch, root=target_root),
+        )
+        root = compute_signing_root(data.hash_tree_root(), domain)
+        sigs = [_sk(int(v)).sign(root) for v in committee]
+        atts.append(
+            types.Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=bls.aggregate_signatures(sigs).to_bytes(),
+            )
+        )
+    return atts
+
+
+@pytest.fixture(scope="module")
+def altair_finality_run(altair_genesis):
+    config, types, state = altair_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    pending = []
+    blocks = []
+    for slot in range(1, 4 * SPE + 1):
+        signed = produce_altair_block(config, types, cached, slot, pending)
+        state_transition(
+            cached, types, signed, verify_state_root=True, verify_signatures=False
+        )
+        blocks.append(signed)
+        pending = produce_attestations(
+            config, types, cached, signed.message.hash_tree_root()
+        )
+    return config, types, cached, blocks
+
+
+def test_altair_finality(altair_finality_run):
+    _, _, cached, _ = altair_finality_run
+    assert cached.current_epoch == 4
+    assert cached.state.current_justified_checkpoint.epoch >= 2
+    assert cached.state.finalized_checkpoint.epoch >= 1
+
+
+def test_altair_participation_and_rewards(altair_finality_run):
+    _, _, cached, _ = altair_finality_run
+    # full participation, no leak: zero inactivity scores, balances grow
+    assert all(s == 0 for s in cached.state.inactivity_scores)
+    assert min(cached.state.balances) > MINIMAL.MAX_EFFECTIVE_BALANCE
+    # previous-epoch participation flags all set (source|target|head = 0b111)
+    assert set(cached.state.previous_epoch_participation) == {7}
+
+
+def test_altair_block_full_verification(altair_genesis):
+    """One block with EVERY signature verified: proposer, randao,
+    attestations, and the 32-pubkey sync aggregate (baseline config #4
+    shape)."""
+    config, types, state = altair_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    b1 = produce_altair_block(config, types, cached, 1, [])
+    state_transition(
+        cached, types, b1, verify_state_root=True, verify_signatures=True
+    )
+    atts = produce_attestations(config, types, cached, b1.message.hash_tree_root())
+    b2 = produce_altair_block(config, types, cached, 2, atts)
+    state_transition(
+        cached, types, b2, verify_state_root=True, verify_signatures=True
+    )
+    assert cached.state.slot == 2
+
+
+def test_chain_import_rejects_bad_sync_signature(altair_genesis):
+    """The batched import path (chain.process_block extracts signature sets
+    and runs state_transition with inline verification OFF) must include
+    the sync-aggregate set — a garbage sync signature may not import."""
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.chain.chain import BlockImportError
+
+    config, types, state = altair_genesis
+    chain = BeaconChain(config, types, state.copy())
+    chain.clock.set_slot(1)
+    cached = chain.head_state
+    good = produce_altair_block(config, types, cached, 1, [])
+    bad = types.SignedBeaconBlock.deserialize(good.serialize())
+    bad.message.body.sync_aggregate.sync_committee_signature = (
+        _sk(99).sign(b"garbage").to_bytes()
+    )
+    # re-sign the block so only the sync aggregate is wrong
+    bad.message.state_root = b"\x00" * 32
+    trial = cached.copy()
+    state_transition(
+        trial, types,
+        types.SignedBeaconBlock(message=bad.message.copy(), signature=b"\x00" * 96),
+        verify_state_root=False, verify_signatures=False,
+    )
+    bad.message.state_root = trial.state.hash_tree_root()
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, 1)
+    bad.signature = _sk(bad.message.proposer_index).sign(
+        compute_signing_root(bad.message.hash_tree_root(), domain)
+    ).to_bytes()
+    with pytest.raises(BlockImportError):
+        chain.process_block(bad, verify_signatures=True)
+    # the honest block imports fine
+    chain.process_block(good, verify_signatures=True)
+
+
+def test_bellatrix_state_rejected_loudly(altair_genesis):
+    config, _, _ = altair_genesis
+    t = get_types(MINIMAL)
+    bella = t.bellatrix.BeaconState()
+    with pytest.raises(NotImplementedError):
+        CachedBeaconState(config, bella, MINIMAL)
+
+
+def test_sync_aggregate_bad_signature_rejected(altair_genesis):
+    config, types, state = altair_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    b1 = produce_altair_block(config, types, cached, 1, [])
+    # corrupt the sync signature
+    b1.message.body.sync_aggregate.sync_committee_signature = (
+        _sk(99).sign(b"wrong").to_bytes()
+    )
+    from lodestar_tpu.state_transition.block import BlockProcessingError
+
+    with pytest.raises(BlockProcessingError):
+        state_transition(
+            cached, types, b1, verify_state_root=False, verify_signatures=True
+        )
