@@ -118,6 +118,11 @@ class BeaconChain:
         self.regen = StateRegenerator(self)
         self.archiver = Archiver(self, self.db)
 
+        # light-client server (altair+ blocks carry sync aggregates)
+        from ..light_client import LightClientServer
+
+        self.light_client_server = LightClientServer(config, types, self.preset)
+
     # -- block import (reference chain/blocks pipeline) ----------------------
 
     def process_block(self, signed_block, verify_signatures: bool = True):
@@ -196,6 +201,19 @@ class BeaconChain:
                 )
             except Exception:
                 continue
+        # light-client data: the sync aggregate in this block signs its
+        # parent (reference: lightClientServer.onImportBlockHead)
+        if hasattr(block.body, "sync_aggregate"):
+            parent_root = bytes(block.parent_root)
+            parent_block = self.blocks.get(parent_root)
+            parent_state = self.state_cache.get_by_block_root(parent_root)
+            if parent_block is not None and parent_state is not None:
+                try:
+                    self.light_client_server.on_import_block(
+                        signed_block, parent_block, parent_state
+                    )
+                except Exception:
+                    pass  # light-client data is best-effort, never blocks import
         self.blocks[block_root] = signed_block
         self.db.block.put(block_root, signed_block)
         self.state_cache.add(state.hash_tree_root(), post, block_root=block_root)

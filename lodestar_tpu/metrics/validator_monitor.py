@@ -1,0 +1,101 @@
+"""ValidatorMonitor: per-validator duty tracking for operators.
+
+Reference: `metrics/validatorMonitor.ts` (478 LoC) — registered validator
+indices get per-epoch summaries (attestation included/missed, inclusion
+distance, head/target correctness, blocks proposed) surfaced as metrics
+and epoch-end log lines.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class EpochSummary:
+    attestation_included: bool = False
+    inclusion_distance: int = 0
+    target_correct: bool = False
+    head_correct: bool = False
+    blocks_proposed: int = 0
+    sync_signatures: int = 0
+
+
+class ValidatorMonitor:
+    def __init__(self, registry=None):
+        self._monitored: set[int] = set()
+        self._summaries: dict[tuple[int, int], EpochSummary] = {}
+        self._metrics = None
+        if registry is not None:
+            self._metrics = {
+                "included": registry.counter(
+                    "validator_monitor_attestation_included_total",
+                    "attestations included for monitored validators",
+                    label_names=("index",),
+                ),
+                "missed": registry.counter(
+                    "validator_monitor_attestation_missed_total",
+                    "attestations missed for monitored validators",
+                    label_names=("index",),
+                ),
+                "proposed": registry.counter(
+                    "validator_monitor_blocks_proposed_total",
+                    "blocks proposed by monitored validators",
+                    label_names=("index",),
+                ),
+            }
+
+    def register_validator(self, index: int) -> None:
+        self._monitored.add(index)
+
+    @property
+    def monitored(self) -> set[int]:
+        return set(self._monitored)
+
+    def _summary(self, index: int, epoch: int) -> EpochSummary:
+        return self._summaries.setdefault((index, epoch), EpochSummary())
+
+    # -- event hooks (called by the import pipeline) -------------------------
+
+    def on_attestation_included(
+        self, epoch: int, indices, inclusion_distance: int,
+        target_correct: bool, head_correct: bool,
+    ) -> None:
+        for idx in indices:
+            if idx in self._monitored:
+                s = self._summary(idx, epoch)
+                # keep the BEST observation across re-inclusions (minimum
+                # distance, OR-ed correctness) — a later aggregate must not
+                # degrade the report
+                if s.attestation_included:
+                    s.inclusion_distance = min(s.inclusion_distance, inclusion_distance)
+                else:
+                    s.attestation_included = True
+                    s.inclusion_distance = inclusion_distance
+                    if self._metrics:
+                        self._metrics["included"].inc(index=str(idx))
+                s.target_correct = s.target_correct or target_correct
+                s.head_correct = s.head_correct or head_correct
+
+    def on_block_proposed(self, epoch: int, proposer_index: int) -> None:
+        if proposer_index in self._monitored:
+            self._summary(proposer_index, epoch).blocks_proposed += 1
+            if self._metrics:
+                self._metrics["proposed"].inc(index=str(proposer_index))
+
+    # -- epoch rollup --------------------------------------------------------
+
+    def summarize_epoch(self, epoch: int) -> dict[int, EpochSummary]:
+        """Epoch-end rollup; validators with no inclusion are counted
+        missed (reference: onceEpochTransition log + metrics)."""
+        out = {}
+        for idx in self._monitored:
+            s = self._summaries.get((idx, epoch), EpochSummary())
+            out[idx] = s
+            if not s.attestation_included and self._metrics:
+                self._metrics["missed"].inc(index=str(idx))
+        # prune old epochs
+        self._summaries = {
+            k: v for k, v in self._summaries.items() if k[1] >= epoch - 1
+        }
+        return out

@@ -627,6 +627,37 @@ class ContainerType(SSZType):
         chunks = b"".join(typ.hash_tree_root(self._get(value, name)) for name, typ in self.fields)
         return merkleize_chunks(chunks)
 
+    def field_index(self, field_name: str) -> int:
+        for i, (name, _) in enumerate(self.fields):
+            if name == field_name:
+                return i
+        raise KeyError(field_name)
+
+    def get_field_branch(self, value: Any, field_name: str) -> list[bytes]:
+        """Merkle sibling path proving `field_name`'s root against this
+        container's hash_tree_root (bottom-up). Compose paths for nested
+        fields by concatenation: inner branch first, then outer."""
+        _, branches = self.get_field_branches(value, [field_name])
+        return branches[field_name]
+
+    def get_field_branches(
+        self, value: Any, field_names: list[str]
+    ) -> tuple[bytes, dict[str, list[bytes]]]:
+        """(container root, {field: branch}) computed from ONE pass over the
+        field roots — callers proving several fields (light-client server)
+        must not re-merkleize the container per field."""
+        from .hashing import merkle_branch
+
+        chunks = [
+            typ.hash_tree_root(self._get(value, name)) for name, typ in self.fields
+        ]
+        root = merkleize_chunks(b"".join(chunks))
+        branches = {
+            name: merkle_branch(chunks, self.field_index(name))
+            for name in field_names
+        }
+        return root, branches
+
     def default(self) -> Any:
         if self.value_class is not None:
             return self.value_class()

@@ -93,6 +93,29 @@ def merkleize_chunks(chunks: list[bytes] | bytes, limit: int | None = None) -> b
     return level
 
 
+def merkle_branch(chunks: list[bytes], index: int, limit: int | None = None) -> list[bytes]:
+    """Sibling path for chunk `index` under the same padding rules as
+    `merkleize_chunks` — bottom-up, `depth` elements. Verifiable with the
+    standard is_valid_merkle_branch walk (the single-proof seam the
+    light-client protocol needs; reference: persistent-merkle-tree proofs)."""
+    count = len(chunks)
+    size = limit if limit is not None else count
+    depth = (next_power_of_two(max(size, 1)) - 1).bit_length()
+    branch: list[bytes] = []
+    level = list(chunks)
+    idx = index
+    for d in range(depth):
+        if len(level) % 2 == 1:
+            level.append(ZERO_HASHES[d])
+        sibling = idx ^ 1
+        branch.append(level[sibling] if sibling < len(level) else ZERO_HASHES[d])
+        level = [
+            hash_pair(level[i], level[i + 1]) for i in range(0, len(level), 2)
+        ]
+        idx //= 2
+    return branch
+
+
 def mix_in_length(root: bytes, length: int) -> bytes:
     return hash_pair(root, length.to_bytes(32, "little"))
 

@@ -1,0 +1,108 @@
+"""Light-client e2e: an altair chain produces updates at import; a client
+bootstraps from a trusted root and follows to the head verifying only
+headers, merkle proofs, and sync signatures (reference: light-client
+package unit + e2e; baseline config #4 — 32-pubkey aggregate verify)."""
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.light_client import Lightclient, LightClientError
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
+from lodestar_tpu.types import get_types
+from tests.test_altair import produce_altair_block, produce_attestations
+
+N = 16
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+
+@pytest.fixture(scope="module")
+def lc_chain():
+    t = get_types(MINIMAL)
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    pre = interop_genesis_state(fork_config, t.phase0, N, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(pre.genesis_validators_root), MINIMAL
+    )
+    state = upgrade_state_to_altair(config, MINIMAL, pre, t.altair)
+    chain = BeaconChain(config, t.altair, state)
+    pending = []
+    roots = []
+    for slot in range(1, 3 * SPE + 1):
+        chain.clock.set_slot(slot)
+        signed = produce_altair_block(
+            config, t.altair, chain.head_state, slot, pending
+        )
+        chain.process_block(signed, verify_signatures=False)
+        roots.append(signed.message.hash_tree_root())
+        pending = produce_attestations(
+            config, t.altair, chain.head_state, roots[-1]
+        )
+    return config, t.altair, chain, roots
+
+
+def test_server_produces_updates_and_bootstrap(lc_chain):
+    config, types, chain, roots = lc_chain
+    server = chain.light_client_server
+    assert server.best_update_by_period  # at least period 0
+    assert server.latest_optimistic_update is not None
+    # bootstrap exists for attested (parent) blocks
+    boot = server.get_bootstrap(roots[0])
+    assert boot is not None
+    assert len(boot.current_sync_committee_branch) == 5
+
+
+def test_client_follows_chain(lc_chain):
+    config, types, chain, roots = lc_chain
+    server = chain.light_client_server
+    client = Lightclient(config, types, MINIMAL)
+    trusted = roots[0]
+    client.bootstrap(trusted, server.get_bootstrap(trusted))
+    assert client.finalized_header.slot == 1
+
+    for period in sorted(server.best_update_by_period):
+        client.process_update(server.best_update_by_period[period])
+    # the best update carries the latest attested header of the period
+    assert client.optimistic_header.slot > 1
+
+    # optimistic fast path advances the head further
+    client.process_optimistic_update(server.latest_optimistic_update)
+    assert client.optimistic_header.slot == 3 * SPE - 1  # head's parent
+
+
+def test_client_rejects_tampered_proofs(lc_chain):
+    config, types, chain, roots = lc_chain
+    server = chain.light_client_server
+    client = Lightclient(config, types, MINIMAL)
+    trusted = roots[0]
+
+    # tampered bootstrap committee
+    boot = server.get_bootstrap(trusted)
+    bad_boot = types.LightClientBootstrap.deserialize(boot.serialize())
+    bad_boot.current_sync_committee.pubkeys[0] = (
+        bls.interop_secret_key(77).to_public_key().to_bytes()
+    )
+    with pytest.raises(LightClientError):
+        client.bootstrap(trusted, bad_boot)
+
+    client.bootstrap(trusted, boot)
+    period = min(server.best_update_by_period)
+    update = server.best_update_by_period[period]
+
+    # tampered next-committee branch
+    bad = types.LightClientUpdate.deserialize(update.serialize())
+    bad.next_sync_committee_branch = [b"\x00" * 32] * 5
+    with pytest.raises(LightClientError):
+        client.process_update(bad)
+
+    # tampered sync signature
+    bad2 = types.LightClientUpdate.deserialize(update.serialize())
+    bad2.sync_aggregate.sync_committee_signature = (
+        bls.interop_secret_key(7).sign(b"x").to_bytes()
+    )
+    with pytest.raises(LightClientError):
+        client.process_update(bad2)

@@ -1,0 +1,93 @@
+"""Unit tests for the small services: attnets subscriptions, peer scoring
+and pruning, reprocess queue, validator monitor."""
+
+from lodestar_tpu.chain.reprocess import ReprocessController
+from lodestar_tpu.metrics import MetricsRegistry
+from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+from lodestar_tpu.network.peers import (
+    PeerAction,
+    PeerManager,
+    PeerRpcScoreStore,
+    ScoreState,
+)
+from lodestar_tpu.network.subnets import AttnetsService
+from lodestar_tpu.params import ATTESTATION_SUBNET_COUNT
+
+
+def test_attnets_rotation_and_enr():
+    svc = AttnetsService(node_id=b"\x01" * 32, slots_per_epoch=8)
+    svc.rotate(epoch=10, validator_count=2)
+    subs = svc.active_subnets(10)
+    assert subs and all(0 <= s < ATTESTATION_SUBNET_COUNT for s in subs)
+    # deterministic within a period
+    again = AttnetsService(node_id=b"\x01" * 32, slots_per_epoch=8)
+    again.rotate(epoch=10, validator_count=2)
+    assert again.active_subnets(10) == subs
+    # short-lived duty subscription not in ENR
+    svc.subscribe_committee(subnet=7, until_epoch=12)
+    assert 7 in svc.active_subnets(11)
+    assert not svc.enr_attnets(11)[7] or 7 in {s.subnet for s in svc.long_lived}
+    # expiry
+    assert 7 not in svc.active_subnets(12)
+
+
+def test_peer_scores_decay_and_ban():
+    now = [1000.0]
+    store = PeerRpcScoreStore(time_fn=lambda: now[0])
+    store.apply_action("p1", PeerAction.MidToleranceError)
+    assert store.state("p1") == ScoreState.Healthy
+    for _ in range(10):
+        store.apply_action("p1", PeerAction.LowToleranceError)
+    assert store.state("p1") == ScoreState.Banned
+    # decay recovers over time
+    now[0] += 3600
+    assert store.state("p1") != ScoreState.Banned
+    store.apply_action("p2", PeerAction.Fatal)
+    assert store.state("p2") == ScoreState.Banned
+
+
+def test_peer_manager_heartbeat_prunes():
+    now = [0.0]
+    pm = PeerManager(target_peers=2, time_fn=lambda: now[0])
+    for i in range(4):
+        assert pm.on_connect(f"p{i}")
+    pm.report_peer("p0", PeerAction.Fatal)     # banned
+    pm.report_peer("p1", PeerAction.LowToleranceError)  # worst healthy
+    dropped = pm.heartbeat()
+    assert "p0" in dropped
+    assert len(pm.peers) <= 2
+    # banned peers cannot reconnect
+    assert not pm.on_connect("p0")
+
+
+def test_reprocess_queue():
+    now = [0.0]
+    rc = ReprocessController(time_fn=lambda: now[0])
+    root = b"\x0a" * 32
+    assert rc.wait_for_block(root, "att1")
+    assert rc.wait_for_block(root, "att2")
+    assert rc.on_block_imported(root) == ["att1", "att2"]
+    assert rc.on_block_imported(root) == []
+    # expiry path
+    rc.wait_for_block(b"\x0b" * 32, "stale")
+    now[0] += 10
+    assert rc.prune() == 1
+
+
+def test_validator_monitor():
+    reg = MetricsRegistry()
+    vm = ValidatorMonitor(reg)
+    vm.register_validator(3)
+    vm.register_validator(4)
+    vm.on_attestation_included(
+        epoch=1, indices=[3, 9], inclusion_distance=1,
+        target_correct=True, head_correct=False,
+    )
+    vm.on_block_proposed(epoch=1, proposer_index=4)
+    summary = vm.summarize_epoch(1)
+    assert summary[3].attestation_included and summary[3].target_correct
+    assert not summary[4].attestation_included
+    assert summary[4].blocks_proposed == 1
+    text = reg.expose()
+    assert 'validator_monitor_attestation_included_total{index="3"} 1' in text
+    assert 'validator_monitor_attestation_missed_total{index="4"} 1' in text
