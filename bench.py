@@ -22,7 +22,7 @@ import time
 import numpy as np
 
 BASELINE_SETS_PER_SEC = 50_000.0  # BASELINE.json north_star target
-BATCH = 1024
+BATCH = 4096
 REPS = 5
 
 

@@ -26,6 +26,7 @@ class ReprocessController:
 
         self._time = time_fn if time_fn is not None else _time.time
         self._by_root: dict[bytes, _Waiting] = {}
+        self._total = 0  # running count — the budget check is on the hot path
         self.metrics = {"queued": 0, "resolved": 0, "dropped": 0}
 
     def wait_for_block(self, block_root: bytes, item) -> bool:
@@ -33,14 +34,14 @@ class ReprocessController:
         `block_root` is imported. False when the global budget is spent —
         checked BEFORE creating any entry, so rejected floods of distinct
         unknown roots leave no residue."""
-        total = sum(len(w.items) for w in self._by_root.values())
-        if total >= MAX_QUEUED_TOTAL:
+        if self._total >= MAX_QUEUED_TOTAL:
             self.metrics["dropped"] += 1
             return False
         waiting = self._by_root.setdefault(
             block_root, _Waiting(added_at=self._time())
         )
         waiting.items.append(item)
+        self._total += 1
         self.metrics["queued"] += 1
         return True
 
@@ -50,6 +51,7 @@ class ReprocessController:
         waiting = self._by_root.pop(block_root, None)
         if waiting is None:
             return []
+        self._total -= len(waiting.items)
         self.metrics["resolved"] += len(waiting.items)
         return waiting.items
 
@@ -61,5 +63,6 @@ class ReprocessController:
             r for r, w in self._by_root.items() if now - w.added_at > max_age_sec
         ]:
             dropped += len(self._by_root.pop(root).items)
+        self._total -= dropped
         self.metrics["dropped"] += dropped
         return dropped

@@ -141,10 +141,25 @@ class Lightclient:
             attested, update.sync_aggregate, update.signature_slot
         )
 
-        # apply
-        if attested_period == store_period + 1:
+        # apply (spec apply_light_client_update): committee rotation keys
+        # off the FINALIZED period so store_period and the committees stay
+        # consistent — rotating on the attested period desyncs the selector
+        # and permanently stalls the client after the first cross-period
+        # update
+        update_finalized_period = (
+            self._period(update.finalized_header.slot)
+            if has_finality
+            else store_period
+        )
+        if self.next_sync_committee is None:
+            _require(
+                update_finalized_period == store_period,
+                "cannot learn next committee from a future-period update",
+            )
+            self.next_sync_committee = update.next_sync_committee.copy()
+        elif update_finalized_period == store_period + 1:
             self.current_sync_committee = self.next_sync_committee
-        self.next_sync_committee = update.next_sync_committee.copy()
+            self.next_sync_committee = update.next_sync_committee.copy()
         if attested.slot > self.optimistic_header.slot:
             self.optimistic_header = attested.copy()
         if has_finality and update.finalized_header.slot > self.finalized_header.slot:

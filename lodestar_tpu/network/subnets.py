@@ -33,7 +33,7 @@ class AttnetsService:
 
     # -- long-lived random subscriptions -------------------------------------
 
-    def _random_subnet(self, validator_count: int, epoch: int, i: int) -> int:
+    def _random_subnet(self, epoch: int, i: int) -> int:
         period = epoch // EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION
         seed = sha256(
             self.node_id + period.to_bytes(8, "little") + i.to_bytes(4, "little")
@@ -49,7 +49,7 @@ class AttnetsService:
             * EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION
         )
         self.long_lived = [
-            Subscription(self._random_subnet(validator_count, epoch, i), period_end)
+            Subscription(self._random_subnet(epoch, i), period_end)
             for i in range(n_subs)
         ]
         self.short_lived = [s for s in self.short_lived if s.until_epoch > epoch]

@@ -91,3 +91,27 @@ def test_validator_monitor():
     text = reg.expose()
     assert 'validator_monitor_attestation_included_total{index="3"} 1' in text
     assert 'validator_monitor_attestation_missed_total{index="4"} 1' in text
+
+
+def test_weak_subjectivity_period():
+    from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.params.presets import MINIMAL
+    from lodestar_tpu.state_transition import CachedBeaconState, interop_genesis_state
+    from lodestar_tpu.state_transition.weak_subjectivity import (
+        compute_weak_subjectivity_period,
+        is_within_weak_subjectivity_period,
+    )
+    from lodestar_tpu.types import get_types
+
+    types = get_types(MINIMAL).phase0
+    fc = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fc, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    cached = CachedBeaconState(config, state, MINIMAL)
+    ws = compute_weak_subjectivity_period(cached)
+    # with full 32-ETH balances the ws period is at least the withdrawability delay
+    assert ws >= config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    assert is_within_weak_subjectivity_period(cached, ws_checkpoint_epoch=0)
