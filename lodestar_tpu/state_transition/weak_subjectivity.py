@@ -9,8 +9,6 @@ average balance (safety decay D = 10%).
 
 from __future__ import annotations
 
-from . import util
-
 SAFETY_DECAY = 10  # percent
 
 

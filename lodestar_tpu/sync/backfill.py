@@ -23,17 +23,23 @@ class BackfillError(Exception):
 
 
 class BackfillSync:
-    def __init__(self, config, types, db, anchor_block, anchor_state, verifier):
+    def __init__(
+        self, config, types, db, anchor_block, anchor_state, verifier,
+        terminal_root: bytes | None = None,
+    ):
         """`anchor_block`: trusted signed block (checkpoint); `anchor_state`
-        its post state (pubkey registry); `verifier`: IBlsVerifier."""
+        its post state (pubkey registry); `verifier`: IBlsVerifier;
+        `terminal_root`: the genesis block root — backfill is complete when
+        the linkage reaches it (None: complete when the slot-1 window is
+        exhausted)."""
         self.config = config
         self.types = types
         self.db = db
         self.verifier = verifier
         self.anchor = anchor_block
+        self.terminal_root = terminal_root
         self._pubkeys = [bytes(v.pubkey) for v in anchor_state.validators]
         self.peers: list[IPeer] = []
-        self.oldest_root = anchor_block.message.hash_tree_root()
         self.oldest_slot = anchor_block.message.slot
         self._expected_parent = bytes(anchor_block.message.parent_root)
 
@@ -77,35 +83,47 @@ class BackfillSync:
     # -- driving -------------------------------------------------------------
 
     def sync_to_genesis(self) -> int:
-        """Backfill until slot 0 is linked; returns number of archived
-        blocks. Peers rotate on failure (reference: batch retries)."""
+        """Backfill until the linkage reaches the terminal (genesis) root,
+        or the slot-1 window is exhausted; returns archived block count."""
         archived = 0
-        while self.oldest_slot > 0 and self._expected_parent != b"\x00" * 32:
-            start = max(0, self.oldest_slot - BACKFILL_BATCH_SLOTS)
+        while self.oldest_slot > 1 and self._expected_parent != self.terminal_root:
+            start = max(1, self.oldest_slot - BACKFILL_BATCH_SLOTS)
             count = self.oldest_slot - start
-            blocks = self._download(start, count)
+            blocks = self._download_verified(start, count)
             if not blocks:
+                if start == 1:
+                    break  # chain has no blocks below oldest_slot — done
                 raise BackfillError(f"no blocks available below {self.oldest_slot}")
-            self._verify_segment(blocks)
             for signed in blocks:
                 self.db.archive_block(signed)
                 archived += 1
             self.oldest_slot = blocks[0].message.slot
-            self.oldest_root = blocks[0].message.hash_tree_root()
             self._expected_parent = bytes(blocks[0].message.parent_root)
-            if blocks[0].message.slot == 1 and self._expected_parent is not None:
-                break  # genesis (slot-0 anchor) reached
         return archived
 
-    def _download(self, start: int, count: int) -> list:
+    def _download_verified(self, start: int, count: int) -> list:
+        """Download + verify one batch, rotating peers on EITHER transport
+        failure or verification failure — one bad peer must not brick
+        backfill while honest peers remain (reference: batch retries with
+        peer rotation)."""
         last_err: Exception | None = None
+        served_empty = False
         for peer in self.peers:
             try:
                 blocks = peer.beacon_blocks_by_range(start, count)
-                if blocks:
-                    return blocks
             except PeerError as e:
                 last_err = e
+                continue
+            if not blocks:
+                served_empty = True
+                continue
+            try:
+                self._verify_segment(blocks)
+                return blocks
+            except BackfillError as e:
+                last_err = e
+        if served_empty:
+            return []  # an honest peer confirms the range is empty
         if last_err is not None:
             raise BackfillError(str(last_err))
         return []

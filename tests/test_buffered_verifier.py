@@ -1,0 +1,108 @@
+"""BufferedVerifier: the async batching front-end reproducing the
+reference pool's dynamic batching (32 sigs / 100 ms window) and the
+batch-failure → per-set fallback semantics (multithread/index.ts:39-57,
+worker.ts:55-95)."""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain.bls_verifier import (
+    MAX_BUFFERED_SIGS,
+    BufferedVerifier,
+    CpuBlsVerifier,
+)
+
+
+def _sets(n, salt=0, bad=()):
+    out = []
+    for i in range(n):
+        sk = bls.interop_secret_key(i + salt)
+        msg = bytes([i & 0xFF]) * 32
+        signer = bls.interop_secret_key(i + salt + 500) if i in bad else sk
+        out.append(
+            bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=signer.sign(msg).to_bytes(),
+            )
+        )
+    return out
+
+
+class CountingVerifier(CpuBlsVerifier):
+    def __init__(self):
+        self.batch_calls = 0
+        self.individual_calls = 0
+
+    def verify_signature_sets(self, sets):
+        self.batch_calls += 1
+        return super().verify_signature_sets(sets)
+
+    def verify_signature_sets_individual(self, sets):
+        self.individual_calls += 1
+        return super().verify_signature_sets_individual(sets)
+
+
+def test_buffer_merges_requests_into_one_batch():
+    inner = CountingVerifier()
+    buffered = BufferedVerifier(inner)
+
+    async def run():
+        a = asyncio.create_task(buffered.verify(_sets(2), batchable=True))
+        b = asyncio.create_task(buffered.verify(_sets(2, salt=10), batchable=True))
+        await asyncio.sleep(0)  # both requests enter the buffer
+        buffered._flush()
+        return await asyncio.gather(a, b)
+
+    results = asyncio.run(run())
+    assert results == [True, True]
+    assert inner.batch_calls == 1  # merged into a single dispatch
+    assert inner.individual_calls == 0
+
+
+def test_buffer_flushes_at_sig_threshold():
+    inner = CountingVerifier()
+    buffered = BufferedVerifier(inner)
+
+    async def run():
+        # one request carrying MAX_BUFFERED_SIGS sets triggers an immediate
+        # flush (no 100 ms wait)
+        return await buffered.verify(_sets(MAX_BUFFERED_SIGS), batchable=True)
+
+    assert asyncio.run(run())
+    assert inner.batch_calls == 1
+
+
+def test_failed_batch_falls_back_to_per_request_verdicts():
+    inner = CountingVerifier()
+    buffered = BufferedVerifier(inner)
+
+    async def run():
+        good = asyncio.create_task(buffered.verify(_sets(2), batchable=True))
+        bad = asyncio.create_task(
+            buffered.verify(_sets(2, salt=20, bad={1}), batchable=True)
+        )
+        await asyncio.sleep(0)
+        buffered._flush()
+        return await asyncio.gather(good, bad)
+
+    results = asyncio.run(run())
+    # one bad set fails ITS request only; the innocent neighbor passes
+    assert results == [True, False]
+    assert inner.batch_calls == 1
+    assert inner.individual_calls == 1
+    assert buffered.metrics["batch_fallbacks"] == 1
+
+
+def test_non_batchable_bypasses_buffer():
+    inner = CountingVerifier()
+    buffered = BufferedVerifier(inner)
+
+    async def run():
+        return await buffered.verify(_sets(1), batchable=False)
+
+    assert asyncio.run(run())
+    assert inner.batch_calls == 1
+    assert len(buffered._buffer) == 0
