@@ -26,7 +26,14 @@ from ..state_transition import util as st_util
 from ..fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
 from .bls_verifier import CpuBlsVerifier, IBlsVerifier
 from .clock import BeaconClock, ManualClock
-from .op_pools import AggregatedAttestationPool, AttestationPool, OpPool
+from .op_pools import (
+    AggregatedAttestationPool,
+    AttestationPool,
+    BlsToExecutionChangePool,
+    OpPool,
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+)
 from .seen_cache import (
     SeenAggregatedAttestations,
     SeenAggregators,
@@ -52,11 +59,13 @@ class BeaconChain:
         verifier: IBlsVerifier | None = None,
         clock: BeaconClock | None = None,
         db=None,
+        execution_engine=None,
     ):
         self.config = config
         self.types = types
         self.preset = config.preset
         self.bls = verifier if verifier is not None else CpuBlsVerifier()
+        self.execution_engine = execution_engine
 
         cached = CachedBeaconState(config, anchor_state, self.preset)
         self.head_state = cached
@@ -103,6 +112,9 @@ class BeaconChain:
         self.attestation_pool = AttestationPool()
         self.aggregated_pool = AggregatedAttestationPool()
         self.op_pool = OpPool()
+        self.sync_committee_pool = SyncCommitteeMessagePool(self.preset)
+        self.sync_contribution_pool = SyncContributionAndProofPool(self.preset)
+        self.bls_changes_pool = BlsToExecutionChangePool()
         self.seen_attesters = SeenAttesters()
         self.seen_aggregators = SeenAggregators()
         self.seen_block_proposers = SeenBlockProposers()
@@ -122,6 +134,10 @@ class BeaconChain:
         from ..light_client import LightClientServer
 
         self.light_client_server = LightClientServer(config, types, self.preset)
+
+        from .prepare_next_slot import PrepareNextSlotScheduler
+
+        self.prepare_next_slot = PrepareNextSlotScheduler(self)
 
     # -- block import (reference chain/blocks pipeline) ----------------------
 
@@ -155,9 +171,26 @@ class BeaconChain:
             sets = get_block_signature_sets(post, self.types, signed_block)
             if not self.bls.verify_signature_sets(sets):
                 raise BlockImportError("block signature set verification failed")
+        # execution payload verification (reference runs this in parallel
+        # with the two above — verifyBlocksExecutionPayloads.ts); SYNCING/
+        # ACCEPTED imports optimistically, INVALID rejects
+        self._verify_execution_payload(post, signed_block)
 
         self._import_block(signed_block, block_root, post)
         return block_root
+
+    def _verify_execution_payload(self, post, signed_block) -> None:
+        if self.execution_engine is None or not post.is_execution:
+            return
+        from ..execution.engine import ExecutePayloadStatus
+        from ..state_transition.bellatrix import has_execution_payload
+
+        body = signed_block.message.body
+        if not has_execution_payload(body):
+            return  # pre-merge empty payload: nothing for the EL
+        status = self.execution_engine.notify_new_payload(body.execution_payload)
+        if status in (ExecutePayloadStatus.INVALID, ExecutePayloadStatus.INVALID_BLOCK_HASH):
+            raise BlockImportError(f"execution payload invalid: {status}")
 
     def _get_pre_state(self, signed_block) -> CachedBeaconState:
         """Pre-state via regen: cache fast path, replay fallback
@@ -220,6 +253,7 @@ class BeaconChain:
         self.seen_block_proposers.add(block.slot, block.proposer_index)
         self.head_state = post
         self.update_head()
+        self._notify_forkchoice_to_engine()
         # prune + archive on finalization advance
         fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
         if fin_epoch > prev_finalized:
@@ -228,7 +262,10 @@ class BeaconChain:
             self.seen_aggregated.prune(fin_epoch)
             self.checkpoint_state_cache.prune_finalized(fin_epoch)
             self.archiver.process_finalized()
+            self.bls_changes_pool.prune(post)
         self.aggregated_pool.prune(post.current_epoch)
+        self.sync_committee_pool.prune(block.slot)
+        self.sync_contribution_pool.prune(block.slot)
 
     def update_head(self) -> bytes:
         self.head_root = self.fork_choice.update_head()
@@ -236,6 +273,29 @@ class BeaconChain:
         if head_state is not None:
             self.head_state = head_state
         return self.head_root
+
+    def _notify_forkchoice_to_engine(self) -> None:
+        """Mirror the beacon head/finalized into the EL (reference:
+        engine_forkchoiceUpdated on head change, importBlock.ts)."""
+        if self.execution_engine is None or not self.head_state.is_execution:
+            return
+        from ..state_transition.bellatrix import is_merge_transition_complete
+
+        state = self.head_state.state
+        if not is_merge_transition_complete(state):
+            return
+        head_hash = bytes(state.latest_execution_payload_header.block_hash)
+        fin_root = self.fork_choice.store.finalized_checkpoint[1]
+        fin_state = self.state_cache.get_by_block_root(fin_root)
+        fin_hash = (
+            bytes(fin_state.state.latest_execution_payload_header.block_hash)
+            if fin_state is not None and fin_state.is_execution
+            else b"\x00" * 32
+        )
+        try:
+            self.execution_engine.notify_forkchoice_update(head_hash, head_hash, fin_hash)
+        except Exception:
+            pass  # EL sync is advisory for the beacon side
 
     # -- attestation intake (gossip path) ------------------------------------
 
@@ -260,20 +320,35 @@ class BeaconChain:
 
     # -- block production (chain/produceBlock) -------------------------------
 
-    def produce_block(self, slot: int, randao_reveal: bytes, graffiti: bytes = b""):
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        graffiti: bytes = b"",
+        fee_recipient: bytes = b"\x00" * 20,
+    ):
         """Assemble an unsigned block on the current head (reference
-        produceBlock/produceBlockBody: pools → ops, eth1 vote, state root)."""
-        pre = self.head_state.copy()
-        if slot > pre.state.slot:
-            process_slots(pre, self.types, slot)
+        produceBlock/produceBlockBody: pools → ops, eth1 vote, sync
+        aggregate, execution payload via engine, state root)."""
+        from ..state_transition.stf import fork_types
+
+        prepared = self.prepare_next_slot.get_prepared(slot, self.head_root)
+        if prepared is not None:
+            pre = prepared.copy()
+        else:
+            pre = self.head_state.copy()
+            if slot > pre.state.slot:
+                process_slots(pre, self.types, slot)
+        types = fork_types(pre)
+        parent_root = pre.state.latest_block_header.hash_tree_root()
         proposer = pre.epoch_ctx.get_beacon_proposer(slot)
         attestations = self.aggregated_pool.get_attestations_for_block(
-            self.types, pre, self.preset.MAX_ATTESTATIONS
+            types, pre, self.preset.MAX_ATTESTATIONS
         )
         prop_slash, att_slash, exits = self.op_pool.get_slashings_and_exits(
             pre, self.preset
         )
-        body = self.types.BeaconBlockBody(
+        body = types.BeaconBlockBody(
             randao_reveal=randao_reveal,
             eth1_data=pre.state.eth1_data.copy(),
             graffiti=graffiti.ljust(32, b"\x00")[:32],
@@ -282,23 +357,78 @@ class BeaconChain:
             attestations=attestations,
             voluntary_exits=[e.copy() for e in exits],
         )
-        block = self.types.BeaconBlock(
+        if hasattr(body, "sync_aggregate"):
+            # the block's sync aggregate signs the parent (previous slot root)
+            body.sync_aggregate = self.sync_contribution_pool.get_sync_aggregate(
+                types, max(slot, 1) - 1, parent_root
+            )
+        if pre.is_execution:
+            payload = self._produce_execution_payload(pre, types, fee_recipient)
+            if payload is not None:
+                body.execution_payload = payload
+        if pre.is_capella:
+            body.bls_to_execution_changes = [
+                c.copy() for c in self.bls_changes_pool.get_for_block(pre, self.preset)
+            ]
+        block = types.BeaconBlock(
             slot=slot,
             proposer_index=proposer,
-            parent_root=pre.state.latest_block_header.hash_tree_root(),
+            parent_root=parent_root,
             state_root=b"\x00" * 32,
             body=body,
         )
         trial = pre.copy()
         state_transition(
             trial,
-            self.types,
-            self.types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
+            types,
+            types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
             verify_state_root=False,
             verify_signatures=False,
         )
         block.state_root = trial.state.hash_tree_root()
         return block
+
+    def _produce_execution_payload(self, pre, types, fee_recipient: bytes):
+        """Build the payload through the engine (reference
+        prepareExecutionPayload → engine.getPayload). Pre-merge (default
+        header, no engine building) → None, leaving the default payload."""
+        if self.execution_engine is None:
+            return None
+        prepared = build_payload_attributes(self.config, pre, types, fee_recipient)
+        if prepared is None:
+            return None  # pre-merge: empty payload until the EL offers one
+        parent_hash, attributes = prepared
+        payload_id = self.execution_engine.notify_forkchoice_update(
+            parent_hash, parent_hash, parent_hash, attributes
+        )
+        if payload_id is None:
+            return None
+        built = self.execution_engine.get_payload(payload_id)
+
+        # engines return either a _MockPayload-like object or an engine-API
+        # JSON dict (ExecutionEngineHttp) — normalize per field
+        def got(name, default=None):
+            if isinstance(built, dict):
+                return built.get(name, default)
+            return getattr(built, name, default)
+
+        fields = dict(
+            parent_hash=_as_bytes(got("parent_hash", b"\x00" * 32)),
+            fee_recipient=_as_bytes(got("fee_recipient", fee_recipient)),
+            state_root=_as_bytes(got("state_root", b"\x00" * 32)),
+            receipts_root=_as_bytes(got("receipts_root", b"\x00" * 32)),
+            prev_randao=_as_bytes(got("prev_randao", attributes.prev_randao)),
+            block_number=int(got("block_number", 0)),
+            gas_limit=int(got("gas_limit", 30_000_000)),
+            gas_used=int(got("gas_used", 0)),
+            timestamp=int(got("timestamp", attributes.timestamp)),
+            base_fee_per_gas=int(got("base_fee_per_gas", 7)),
+            block_hash=_as_bytes(got("block_hash", b"\x00" * 32)),
+            transactions=[_as_bytes(tx) for tx in got("transactions", []) or []],
+        )
+        if pre.is_capella:
+            fields["withdrawals"] = list(got("withdrawals", []) or [])
+        return types.ExecutionPayload(**fields)
 
     @property
     def finalized_checkpoint(self):
@@ -307,6 +437,41 @@ class BeaconChain:
     @property
     def justified_checkpoint(self):
         return self.fork_choice.store.justified_checkpoint
+
+
+def build_payload_attributes(config, pre, types, fee_recipient: bytes = b"\x00" * 20):
+    """(parent_hash, PayloadAttributes) for building the next payload on
+    `pre`'s head, or None pre-merge. Shared by produce_block and the
+    prepare-next-slot scheduler (reference: prepareExecutionPayload)."""
+    from ..execution.engine import PayloadAttributes
+    from ..state_transition.bellatrix import (
+        compute_timestamp_at_slot,
+        get_randao_mix,
+        is_merge_transition_complete,
+    )
+
+    state = pre.state
+    if not is_merge_transition_complete(state):
+        return None
+    withdrawals = []
+    if pre.is_capella:
+        from ..state_transition.capella import get_expected_withdrawals
+
+        withdrawals = get_expected_withdrawals(pre, types)
+    attributes = PayloadAttributes(
+        timestamp=compute_timestamp_at_slot(config, state),
+        prev_randao=get_randao_mix(state, pre.current_epoch, pre.preset),
+        suggested_fee_recipient=fee_recipient,
+        withdrawals=withdrawals,
+    )
+    return bytes(state.latest_execution_payload_header.block_hash), attributes
+
+
+def _as_bytes(value) -> bytes:
+    """Engine JSON uses 0x-hex strings; mocks use bytes."""
+    if isinstance(value, str):
+        return bytes.fromhex(value[2:] if value.startswith("0x") else value)
+    return bytes(value)
 
 
 def _anchor_block_root(state) -> bytes:

@@ -166,3 +166,151 @@ class OpPool:
             for i, e in self.voluntary_exits.items()
             if int(cached.flat.exit_epoch[i]) == 2**64 - 1
         }
+
+
+class SyncCommitteeMessagePool:
+    """Per-subnet aggregation of individual sync-committee messages into
+    contributions (reference syncCommitteeMessagePool.ts: bits + aggregated
+    signature per (slot, block_root, subcommittee))."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self, preset):
+        self.preset = preset
+        from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        self.subnet_size = preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        # (slot, root, subcommittee) → (bits list, list[signature bytes])
+        self._store: dict[tuple[int, bytes, int], tuple[list[bool], list[bytes]]] = {}
+
+    def add(self, message, subcommittee_index: int, position_in_subcommittee: int):
+        key = (message.slot, bytes(message.beacon_block_root), subcommittee_index)
+        bits, sigs = self._store.setdefault(
+            key, ([False] * self.subnet_size, [])
+        )
+        if bits[position_in_subcommittee]:
+            return  # duplicate participant
+        bits[position_in_subcommittee] = True
+        sigs.append(bytes(message.signature))
+
+    def get_contribution(self, types, slot: int, block_root: bytes, subcommittee: int):
+        from ..bls import api as bls
+
+        entry = self._store.get((slot, bytes(block_root), subcommittee))
+        if entry is None:
+            return None
+        bits, sigs = entry
+        agg = bls.aggregate_signatures(
+            [bls.Signature.from_bytes(s, validate=False) for s in sigs]
+        )
+        return types.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=bytes(block_root),
+            subcommittee_index=subcommittee,
+            aggregation_bits=list(bits),
+            signature=agg.to_bytes(),
+        )
+
+    def prune(self, clock_slot: int) -> None:
+        self._store = {
+            k: v for k, v in self._store.items() if k[0] + self.SLOTS_RETAINED >= clock_slot
+        }
+
+
+class SyncContributionAndProofPool:
+    """Best contribution per (slot, root, subcommittee), merged into the
+    block's SyncAggregate (reference syncContributionAndProofPool.ts
+    `getAggregate`)."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self, preset):
+        self.preset = preset
+        from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+
+        self.subnet_count = SYNC_COMMITTEE_SUBNET_COUNT
+        self.subnet_size = preset.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        # (slot, root, subcommittee) → best contribution (most bits)
+        self._best: dict[tuple[int, bytes, int], object] = {}
+
+    def add(self, contribution) -> None:
+        key = (
+            contribution.slot,
+            bytes(contribution.beacon_block_root),
+            contribution.subcommittee_index,
+        )
+        existing = self._best.get(key)
+        if existing is None or sum(contribution.aggregation_bits) > sum(
+            existing.aggregation_bits
+        ):
+            self._best[key] = contribution.copy()
+
+    def get_sync_aggregate(self, types, slot: int, block_root: bytes):
+        """SyncAggregate for a block at `slot` signing `block_root` (the
+        parent). Empty participation → infinity signature, per spec."""
+        from ..bls import api as bls
+
+        bits = [False] * self.preset.SYNC_COMMITTEE_SIZE
+        sigs = []
+        for sub in range(self.subnet_count):
+            contrib = self._best.get((slot, bytes(block_root), sub))
+            if contrib is None:
+                continue
+            for i, b in enumerate(contrib.aggregation_bits):
+                if b:
+                    bits[sub * self.subnet_size + i] = True
+            sigs.append(
+                bls.Signature.from_bytes(bytes(contrib.signature), validate=False)
+            )
+        if not sigs:
+            return types.SyncAggregate(
+                sync_committee_bits=bits,
+                sync_committee_signature=b"\xc0" + b"\x00" * 95,
+            )
+        return types.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=bls.aggregate_signatures(sigs).to_bytes(),
+        )
+
+    def prune(self, clock_slot: int) -> None:
+        self._best = {
+            k: v for k, v in self._best.items() if k[0] + self.SLOTS_RETAINED >= clock_slot
+        }
+
+
+class BlsToExecutionChangePool:
+    """Pending capella credential changes, deduped per validator
+    (reference opPool bls_to_execution_changes handling)."""
+
+    def __init__(self):
+        self._by_validator: dict[int, object] = {}
+
+    def add(self, signed_change) -> None:
+        self._by_validator.setdefault(
+            signed_change.message.validator_index, signed_change
+        )
+
+    def get_for_block(self, cached, preset) -> list:
+        from ..params import BLS_WITHDRAWAL_PREFIX
+
+        out = []
+        for idx, change in self._by_validator.items():
+            if idx >= len(cached.state.validators):
+                continue
+            wc = bytes(cached.state.validators[idx].withdrawal_credentials)
+            if wc[:1] == BLS_WITHDRAWAL_PREFIX:
+                out.append(change)
+            if len(out) == preset.MAX_BLS_TO_EXECUTION_CHANGES:
+                break
+        return out
+
+    def prune(self, cached) -> None:
+        from ..params import BLS_WITHDRAWAL_PREFIX
+
+        self._by_validator = {
+            i: c
+            for i, c in self._by_validator.items()
+            if i < len(cached.state.validators)
+            and bytes(cached.state.validators[i].withdrawal_credentials)[:1]
+            == BLS_WITHDRAWAL_PREFIX
+        }

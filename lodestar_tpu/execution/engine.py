@@ -35,6 +35,8 @@ class PayloadAttributes:
     timestamp: int
     prev_randao: bytes
     suggested_fee_recipient: bytes
+    # capella (engine API v2): expected withdrawals for the built payload
+    withdrawals: list = field(default_factory=list)
 
 
 class IExecutionEngine(Protocol):
@@ -60,6 +62,7 @@ class _MockPayload:
     prev_randao: bytes
     fee_recipient: bytes
     transactions: list = field(default_factory=list)
+    withdrawals: list = field(default_factory=list)
 
 
 class ExecutionEngineMock:
@@ -124,6 +127,7 @@ class ExecutionEngineMock:
             timestamp=attributes.timestamp,
             prev_randao=attributes.prev_randao,
             fee_recipient=attributes.suggested_fee_recipient,
+            withdrawals=list(attributes.withdrawals),
         )
         return payload_id
 
@@ -193,13 +197,26 @@ class ExecutionEngineHttp:
             "finalizedBlockHash": "0x" + finalized.hex(),
         }
         attrs = None
+        version = "V1"
         if attributes is not None:
             attrs = {
                 "timestamp": hex(attributes.timestamp),
                 "prevRandao": "0x" + attributes.prev_randao.hex(),
                 "suggestedFeeRecipient": "0x" + attributes.suggested_fee_recipient.hex(),
             }
-        result = self._call("engine_forkchoiceUpdatedV1", [fc_state, attrs])
+            if attributes.withdrawals:
+                # capella: engine API V2 carries the expected withdrawals
+                version = "V2"
+                attrs["withdrawals"] = [
+                    {
+                        "index": hex(w.index),
+                        "validatorIndex": hex(w.validator_index),
+                        "address": "0x" + bytes(w.address).hex(),
+                        "amount": hex(w.amount),
+                    }
+                    for w in attributes.withdrawals
+                ]
+        result = self._call(f"engine_forkchoiceUpdated{version}", [fc_state, attrs])
         payload_id = result.get("payloadId")
         return payload_id
 

@@ -378,9 +378,14 @@ def process_rewards_and_penalties_altair(cached) -> None:
     )
     not_target = eligible & ~target_flag
     scores = cached.inactivity_scores.astype(np.int64)
+    inactivity_quotient = (
+        p.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+        if cached.is_execution
+        else p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    )
     penalties[not_target] += (
         eff[not_target] * scores[not_target]
-        // (config.INACTIVITY_SCORE_BIAS * p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+        // (config.INACTIVITY_SCORE_BIAS * inactivity_quotient)
     )
 
     bal = flat.balances.astype(np.int64) + rewards
@@ -407,9 +412,12 @@ def process_slashings_altair(cached) -> None:
     inc = p.EFFECTIVE_BALANCE_INCREMENT
     total = flat.total_active_balance(epoch, inc)
     total_slashings = sum(int(x) for x in state.slashings)
-    adjusted = min(
-        total_slashings * p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total
+    multiplier = (
+        p.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+        if cached.is_execution
+        else p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
     )
+    adjusted = min(total_slashings * multiplier, total)
     target_epoch = epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2
     hit = flat.slashed & (flat.withdrawable_epoch == U64(target_epoch))
     for i in np.nonzero(hit)[0]:
@@ -437,7 +445,12 @@ def process_epoch_altair(cached, types) -> None:
     process_effective_balance_updates(cached)
     process_slashings_reset(cached)
     process_randao_mixes_reset(cached)
-    process_historical_roots_update(cached, types)
+    if cached.is_capella:
+        from .capella import process_historical_summaries_update
+
+        process_historical_summaries_update(cached, types)
+    else:
+        process_historical_roots_update(cached, types)
     process_participation_flag_updates(cached)
     process_sync_committee_updates(cached, types)
 

@@ -85,8 +85,18 @@ def get_validator_churn_limit(cached) -> int:
     )
 
 
+def min_slashing_penalty_quotient(cached) -> int:
+    """Per-fork slashing penalty quotient (spec slash_validator variants)."""
+    p = cached.preset
+    if cached.is_execution:
+        return p.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    if cached.is_altair:
+        return p.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    return p.MIN_SLASHING_PENALTY_QUOTIENT
+
+
 def slash_validator(cached, slashed_index: int, whistleblower_index: int | None = None):
-    """Spec slash_validator (phase0 quotients)."""
+    """Spec slash_validator (fork-aware penalty quotient + proposer cut)."""
     flat, p = cached.flat, cached.preset
     epoch = cached.current_epoch
     initiate_validator_exit(cached, slashed_index)
@@ -99,11 +109,16 @@ def slash_validator(cached, slashed_index: int, whistleblower_index: int | None 
     state = cached.state
     idx = epoch % p.EPOCHS_PER_SLASHINGS_VECTOR
     state.slashings[idx] = state.slashings[idx] + eff
-    decrease_balance(cached, slashed_index, eff // p.MIN_SLASHING_PENALTY_QUOTIENT)
+    decrease_balance(cached, slashed_index, eff // min_slashing_penalty_quotient(cached))
 
     proposer_index = cached.epoch_ctx.get_beacon_proposer(state.slot)
     whistleblower_reward = eff // p.WHISTLEBLOWER_REWARD_QUOTIENT
-    proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
+    if cached.is_altair:
+        from ..params import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
+
+        proposer_reward = whistleblower_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    else:
+        proposer_reward = whistleblower_reward // p.PROPOSER_REWARD_QUOTIENT
     increase_balance(cached, proposer_index, proposer_reward)
     increase_balance(
         cached,
@@ -464,10 +479,26 @@ def process_operations(cached, types, body, verify_signatures: bool = True) -> N
         process_deposit(cached, types, op)
     for op in body.voluntary_exits:
         process_voluntary_exit(cached, op, verify_signatures)
+    if cached.is_capella:
+        from .capella import process_bls_to_execution_change
+
+        for op in body.bls_to_execution_changes:
+            process_bls_to_execution_change(cached, op, verify_signatures)
 
 
-def process_block(cached, types, block, verify_signatures: bool = True) -> None:
+def process_block(
+    cached, types, block, verify_signatures: bool = True, execution_engine=None
+) -> None:
     process_block_header(cached, types, block)
+    if cached.is_execution:
+        from .bellatrix import is_execution_enabled, process_execution_payload
+
+        if is_execution_enabled(cached.state, block.body):
+            if cached.is_capella:
+                from .capella import process_withdrawals
+
+                process_withdrawals(cached, types, block.body.execution_payload)
+            process_execution_payload(cached, types, block.body, execution_engine)
     process_randao(cached, block.body, verify_signatures)
     process_eth1_data(cached, types, block.body)
     process_operations(cached, types, block.body, verify_signatures)

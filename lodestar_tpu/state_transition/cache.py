@@ -216,16 +216,25 @@ class CachedBeaconState:
         self.preset = preset if preset is not None else config.preset
         self.state = state
         self.flat = FlatValidators(state)
+        # Fork detection by state shape (each fork adds fields); drives the
+        # per-fork branches in block/epoch processing (reference: ForkSeq
+        # comparisons throughout state-transition/src).
+        from ..params import ForkName, ForkSeq
+
+        if hasattr(state, "next_withdrawal_index"):
+            self.fork = ForkName.capella
+        elif hasattr(state, "latest_execution_payload_header"):
+            self.fork = ForkName.bellatrix
+        elif hasattr(state, "previous_epoch_participation"):
+            self.fork = ForkName.altair
+        else:
+            self.fork = ForkName.phase0
+        self.fork_seq = ForkSeq[self.fork]
         # altair+: participation flags + inactivity scores mirror into flat
         # arrays (same pattern as FlatValidators)
-        self.is_altair = hasattr(state, "previous_epoch_participation")
-        if hasattr(state, "latest_execution_payload_header"):
-            # bellatrix/capella states would silently run altair-only
-            # processing (wrong slashing/inactivity constants, no payload
-            # handling) — fail loudly until those forks are implemented
-            raise NotImplementedError(
-                "bellatrix/capella state transition not implemented yet"
-            )
+        self.is_altair = self.fork_seq >= ForkSeq.altair
+        self.is_execution = self.fork_seq >= ForkSeq.bellatrix
+        self.is_capella = self.fork_seq >= ForkSeq.capella
         if self.is_altair:
             self.previous_participation = np.array(
                 state.previous_epoch_participation, np.uint8
@@ -279,3 +288,9 @@ class CachedBeaconState:
     def copy(self) -> "CachedBeaconState":
         self.sync_flat()  # flat arrays may be dirty mid-pipeline
         return CachedBeaconState(self.config, self.state.copy(), self.preset)
+
+    def reload_state(self, state) -> None:
+        """Adopt a new underlying state in place (fork upgrades swap the
+        state container type mid-process_slots; reference rebuilds the
+        CachedBeaconState on upgrade — stateTransition.ts processSlots)."""
+        self.__init__(self.config, state, self.preset)

@@ -177,4 +177,21 @@ def get_block_signature_sets(
         sync_set = sync_aggregate_signature_set(cached, block)
         if sync_set is not None:
             sets.append(sync_set)
+    if cached.is_capella:
+        for op in body.bls_to_execution_changes:
+            sets.append(bls_to_execution_change_signature_set(cached, op))
     return sets
+
+
+def bls_to_execution_change_signature_set(cached, signed_change) -> bls.SignatureSet:
+    from .capella import bls_to_execution_change_signing_root
+
+    return bls.SignatureSet(
+        pubkey=bls.PublicKey.from_bytes(
+            bytes(signed_change.message.from_bls_pubkey), validate=False
+        ),
+        message=bls_to_execution_change_signing_root(
+            cached.config, cached.state, signed_change.message
+        ),
+        signature=bytes(signed_change.signature),
+    )

@@ -99,6 +99,18 @@ class BackfillSync:
                 archived += 1
             self.oldest_slot = blocks[0].message.slot
             self._expected_parent = bytes(blocks[0].message.parent_root)
+        # an empty final window is only complete if the linkage actually
+        # reached the terminal root — otherwise a peer served a lying empty
+        # response over an unreachable hole
+        if (
+            self.terminal_root is not None
+            and self._expected_parent != self.terminal_root
+        ):
+            raise BackfillError(
+                f"backfill incomplete: linkage stopped at "
+                f"{self._expected_parent.hex()[:12]}, terminal "
+                f"{self.terminal_root.hex()[:12]} not reached"
+            )
         return archived
 
     def _download_verified(self, start: int, count: int) -> list:
@@ -106,13 +118,14 @@ class BackfillSync:
         failure or verification failure — one bad peer must not brick
         backfill while honest peers remain (reference: batch retries with
         peer rotation)."""
-        last_err: Exception | None = None
+        transport_err: Exception | None = None
+        verify_err: Exception | None = None
         served_empty = False
         for peer in self.peers:
             try:
                 blocks = peer.beacon_blocks_by_range(start, count)
             except PeerError as e:
-                last_err = e
+                transport_err = e
                 continue
             if not blocks:
                 served_empty = True
@@ -121,9 +134,13 @@ class BackfillSync:
                 self._verify_segment(blocks)
                 return blocks
             except BackfillError as e:
-                last_err = e
+                verify_err = e
+        # a verification failure is stronger evidence than an empty reply:
+        # some peer HAS blocks for this range, so don't accept emptiness
+        if verify_err is not None:
+            raise BackfillError(str(verify_err))
         if served_empty:
-            return []  # an honest peer confirms the range is empty
-        if last_err is not None:
-            raise BackfillError(str(last_err))
+            return []  # every responsive peer confirms the range is empty
+        if transport_err is not None:
+            raise BackfillError(str(transport_err))
         return []

@@ -234,12 +234,21 @@ def test_chain_import_rejects_bad_sync_signature(altair_genesis):
     chain.process_block(good, verify_signatures=True)
 
 
-def test_bellatrix_state_rejected_loudly(altair_genesis):
-    config, _, _ = altair_genesis
+def test_fork_detection_by_state_shape(altair_genesis):
+    config, _, state = altair_genesis
+    from lodestar_tpu.params import ForkName
+    from lodestar_tpu.state_transition.bellatrix import upgrade_state_to_bellatrix
+    from lodestar_tpu.state_transition.capella import upgrade_state_to_capella
+
     t = get_types(MINIMAL)
-    bella = t.bellatrix.BeaconState()
-    with pytest.raises(NotImplementedError):
-        CachedBeaconState(config, bella, MINIMAL)
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    assert cached.fork == ForkName.altair and not cached.is_execution
+    bella = upgrade_state_to_bellatrix(config, MINIMAL, state.copy(), t.bellatrix)
+    cached = CachedBeaconState(config, bella, MINIMAL)
+    assert cached.fork == ForkName.bellatrix and cached.is_execution
+    cap = upgrade_state_to_capella(config, MINIMAL, bella, t.capella)
+    cached = CachedBeaconState(config, cap, MINIMAL)
+    assert cached.fork == ForkName.capella and cached.is_capella
 
 
 def test_sync_aggregate_bad_signature_rejected(altair_genesis):

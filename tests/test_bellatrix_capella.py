@@ -1,0 +1,304 @@
+"""bellatrix + capella state-transition tests: scheduled fork upgrades in
+process_slots, execution payload processing, withdrawals sweep, BLS→execution
+credential changes (reference analog: bellatrix/capella sanity + transition
+spec suites)."""
+
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.config.beacon_config import BeaconConfig, compute_signing_root
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    ForkName,
+)
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.ssz.hashing import sha256
+from lodestar_tpu.state_transition import (
+    CachedBeaconState,
+    interop_genesis_state,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
+from lodestar_tpu.state_transition.bellatrix import (
+    is_execution_enabled,
+    is_merge_transition_complete,
+    upgrade_state_to_bellatrix,
+)
+from lodestar_tpu.state_transition.block import _epoch_signing_root
+from lodestar_tpu.state_transition.capella import (
+    get_expected_withdrawals,
+    process_bls_to_execution_change,
+    upgrade_state_to_capella,
+)
+from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
+from lodestar_tpu.chain.bls_verifier import CpuBlsVerifier
+from lodestar_tpu.types import get_types
+
+N = 16
+SPE = MINIMAL.SLOTS_PER_EPOCH
+
+SCHEDULED = dataclasses.replace(
+    MINIMAL_CHAIN_CONFIG,
+    ALTAIR_FORK_EPOCH=0,
+    BELLATRIX_FORK_EPOCH=1,
+    CAPELLA_FORK_EPOCH=2,
+)
+
+
+def _sk(i):
+    return bls.interop_secret_key(i)
+
+
+@pytest.fixture(scope="module")
+def scheduled_genesis():
+    """Altair genesis under a schedule that forks to bellatrix at epoch 1
+    and capella at epoch 2."""
+    t = get_types(MINIMAL)
+    from lodestar_tpu.config.beacon_config import ChainForkConfig
+
+    fork_config = ChainForkConfig(SCHEDULED, MINIMAL)
+    pre = interop_genesis_state(fork_config, t.phase0, N, genesis_time=1_600_000_000)
+    config = BeaconConfig(SCHEDULED, bytes(pre.genesis_validators_root), MINIMAL)
+    state = upgrade_state_to_altair(config, MINIMAL, pre, t.altair)
+    return config, t, state
+
+
+def test_scheduled_upgrades_in_process_slots(scheduled_genesis):
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    assert cached.fork == ForkName.altair
+
+    process_slots(cached, t.altair, SPE)  # enter epoch 1 → bellatrix
+    assert cached.fork == ForkName.bellatrix
+    assert bytes(cached.state.fork.current_version) == config.BELLATRIX_FORK_VERSION
+    assert not is_merge_transition_complete(cached.state)
+
+    process_slots(cached, t.bellatrix, 2 * SPE)  # enter epoch 2 → capella
+    assert cached.fork == ForkName.capella
+    assert bytes(cached.state.fork.current_version) == config.CAPELLA_FORK_VERSION
+    assert cached.state.next_withdrawal_index == 0
+    assert cached.state.next_withdrawal_validator_index == 0
+    assert len(cached.state.historical_summaries) == 0
+    # participation flags survived both upgrades
+    assert len(cached.state.previous_epoch_participation) == N
+
+
+def _produce_block(config, types, cached, slot, payload=None, changes=()):
+    """Minimal valid block at `slot` (no attestations; optional payload)."""
+    pre = cached.copy()
+    if slot > pre.state.slot:
+        process_slots(pre, types, slot)
+    types = get_types(MINIMAL).by_fork[pre.fork]
+    proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+    sk = _sk(proposer)
+    body = types.BeaconBlockBody(
+        randao_reveal=sk.sign(
+            _epoch_signing_root(slot // SPE, config.get_domain(DOMAIN_RANDAO, slot))
+        ).to_bytes(),
+        eth1_data=pre.state.eth1_data.copy(),
+    )
+    if hasattr(body, "sync_aggregate"):
+        body.sync_aggregate = types.SyncAggregate(
+            sync_committee_bits=[False] * MINIMAL.SYNC_COMMITTEE_SIZE,
+            sync_committee_signature=b"\xc0" + b"\x00" * 95,
+        )
+    if payload is not None:
+        body.execution_payload = payload
+    if changes:
+        body.bls_to_execution_changes = list(changes)
+    block = types.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=pre.state.latest_block_header.hash_tree_root(),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    trial = pre.copy()
+    state_transition(
+        trial,
+        types,
+        types.SignedBeaconBlock(message=block.copy(), signature=b"\x00" * 96),
+        verify_state_root=False,
+        verify_signatures=False,
+    )
+    block.state_root = trial.state.hash_tree_root()
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, slot)
+    sig = sk.sign(compute_signing_root(block.hash_tree_root(), domain))
+    return types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+
+
+def test_bellatrix_pre_merge_blocks(scheduled_genesis):
+    """Pre-merge bellatrix blocks carry default payloads; execution is
+    disabled until a non-default payload lands."""
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, t.altair, SPE)
+    signed = _produce_block(config, t.bellatrix, cached, SPE + 1)
+    assert not is_execution_enabled(cached.state, signed.message.body)
+    state_transition(cached, t.bellatrix, signed, verify_signatures=True)
+    assert cached.state.slot == SPE + 1
+
+
+def _merge_payload(types, cached, config):
+    """A structurally valid merge-transition payload for the next slot."""
+    from lodestar_tpu.state_transition.bellatrix import (
+        compute_timestamp_at_slot,
+        get_randao_mix,
+    )
+
+    state = cached.state
+    return types.ExecutionPayload(
+        parent_hash=b"\x11" * 32,
+        fee_recipient=b"\x22" * 20,
+        state_root=b"\x33" * 32,
+        receipts_root=b"\x44" * 32,
+        prev_randao=get_randao_mix(state, cached.current_epoch, cached.preset),
+        block_number=1,
+        gas_limit=30_000_000,
+        gas_used=21_000,
+        timestamp=compute_timestamp_at_slot(config, state),
+        base_fee_per_gas=7,
+        block_hash=b"\x55" * 32,
+        transactions=[b"\x01\x02"],
+    )
+
+
+def test_bellatrix_merge_transition_block(scheduled_genesis):
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, t.altair, SPE + 1)
+
+    payload = _merge_payload(t.bellatrix, cached, config)
+    # build by hand at the current slot (payload fields depend on post-slot
+    # state, so _produce_block's process_slots path would skew timestamp)
+    signed = _produce_block(config, t.bellatrix, cached, SPE + 1, payload=payload)
+    assert is_execution_enabled(cached.state, signed.message.body)
+    state_transition(cached, t.bellatrix, signed, verify_signatures=True)
+    assert is_merge_transition_complete(cached.state)
+    hdr = cached.state.latest_execution_payload_header
+    assert bytes(hdr.block_hash) == b"\x55" * 32
+    assert hdr.block_number == 1
+
+
+def test_capella_withdrawals_sweep(scheduled_genesis):
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, t.altair, 2 * SPE)
+    assert cached.fork == ForkName.capella
+    state = cached.state
+
+    # validator 0: fully withdrawable (eth1 creds, withdrawable now, has balance)
+    state.validators[0].withdrawal_credentials = (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xaa" * 20
+    )
+    state.validators[0].withdrawable_epoch = 0
+    # validator 1: partially withdrawable (max effective, excess balance)
+    state.validators[1].withdrawal_credentials = (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xbb" * 20
+    )
+    state.balances[1] = MINIMAL.MAX_EFFECTIVE_BALANCE + 123
+    cached.reload_state(state)
+
+    ws = get_expected_withdrawals(cached, t.capella)
+    by_validator = {w.validator_index: w for w in ws}
+    assert 0 in by_validator and by_validator[0].amount == int(
+        cached.flat.balances[0]
+    )
+    assert 1 in by_validator and by_validator[1].amount == 123
+    assert bytes(by_validator[0].address) == b"\xaa" * 20
+
+
+def test_capella_bls_to_execution_change(scheduled_genesis):
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, t.altair, 2 * SPE)
+
+    idx = 3
+    sk = _sk(idx)  # interop: withdrawal key == signing key
+    change = t.capella.BLSToExecutionChange(
+        validator_index=idx,
+        from_bls_pubkey=sk.to_public_key().to_bytes(),
+        to_execution_address=b"\xcc" * 20,
+    )
+    from lodestar_tpu.state_transition.capella import (
+        bls_to_execution_change_signing_root,
+    )
+
+    root = bls_to_execution_change_signing_root(config, cached.state, change)
+    signed_change = t.capella.SignedBLSToExecutionChange(
+        message=change, signature=sk.sign(root).to_bytes()
+    )
+    process_bls_to_execution_change(cached, signed_change, verify_signatures=True)
+    wc = bytes(cached.state.validators[idx].withdrawal_credentials)
+    assert wc[:1] == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert wc[12:] == b"\xcc" * 20
+
+    # wrong signature rejected
+    bad = t.capella.SignedBLSToExecutionChange(
+        message=t.capella.BLSToExecutionChange(
+            validator_index=4,
+            from_bls_pubkey=_sk(4).to_public_key().to_bytes(),
+            to_execution_address=b"\xdd" * 20,
+        ),
+        signature=sk.sign(root).to_bytes(),
+    )
+    with pytest.raises(Exception):
+        process_bls_to_execution_change(cached, bad, verify_signatures=True)
+
+
+def test_capella_block_with_change_signature_sets(scheduled_genesis):
+    """A capella block carrying a credential change: its signature set is
+    extracted and the whole block batch-verifies."""
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    process_slots(cached, t.altair, 2 * SPE)
+
+    idx = 5
+    sk = _sk(idx)
+    change = t.capella.BLSToExecutionChange(
+        validator_index=idx,
+        from_bls_pubkey=sk.to_public_key().to_bytes(),
+        to_execution_address=b"\xee" * 20,
+    )
+    from lodestar_tpu.state_transition.capella import (
+        bls_to_execution_change_signing_root,
+    )
+
+    signed_change = t.capella.SignedBLSToExecutionChange(
+        message=change,
+        signature=sk.sign(
+            bls_to_execution_change_signing_root(config, cached.state, change)
+        ).to_bytes(),
+    )
+    signed = _produce_block(
+        config, t.capella, cached, 2 * SPE + 1, changes=[signed_change]
+    )
+    post = cached.copy()
+    state_transition(post, t.capella, signed, verify_signatures=False)
+    sets = get_block_signature_sets(post, t.capella, signed)
+    # proposer + randao + the credential change
+    assert len(sets) == 3
+    assert CpuBlsVerifier().verify_signature_sets(sets)
+    wc = bytes(post.state.validators[idx].withdrawal_credentials)
+    assert wc[12:] == b"\xee" * 20
+
+
+def test_capella_finality(scheduled_genesis):
+    """Chain across both fork boundaries to epoch 4 with empty blocks: the
+    transition machinery stays consistent across upgrades (roots verified
+    every block)."""
+    config, t, state = scheduled_genesis
+    cached = CachedBeaconState(config, state.copy(), MINIMAL)
+    for slot in range(1, 3 * SPE + 1):
+        signed = _produce_block(config, t.altair, cached, slot)
+        state_transition(
+            cached, t.altair, signed, verify_state_root=True, verify_signatures=False
+        )
+    assert cached.fork == ForkName.capella
+    assert cached.state.slot == 3 * SPE

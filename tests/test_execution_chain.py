@@ -1,0 +1,172 @@
+"""Post-merge chain e2e: a capella dev chain driven through the BeaconChain
+with the mock EL — produce_block builds payloads via the engine, the import
+pipeline verifies them, withdrawals sweep, sync-aggregate pool feeds blocks
+(reference analog: merge-interop sim test, SURVEY.md §4.5)."""
+
+import dataclasses
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain, CpuBlsVerifier
+from lodestar_tpu.config.beacon_config import (
+    BeaconConfig,
+    ChainForkConfig,
+    compute_signing_root,
+)
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.execution.engine import ExecutionEngineMock
+from lodestar_tpu.params import (
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    ForkName,
+)
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
+from lodestar_tpu.state_transition.bellatrix import upgrade_state_to_bellatrix
+from lodestar_tpu.state_transition.block import _epoch_signing_root
+from lodestar_tpu.state_transition.capella import upgrade_state_to_capella
+from lodestar_tpu.types import get_types
+
+N = 16
+SPE = MINIMAL.SLOTS_PER_EPOCH
+EL_GENESIS_HASH = b"\x01" * 32
+
+ALL_FORKS_AT_GENESIS = dataclasses.replace(
+    MINIMAL_CHAIN_CONFIG,
+    ALTAIR_FORK_EPOCH=0,
+    BELLATRIX_FORK_EPOCH=0,
+    CAPELLA_FORK_EPOCH=0,
+)
+
+
+def _sk(i):
+    return bls.interop_secret_key(i)
+
+
+@pytest.fixture(scope="module")
+def capella_chain():
+    t = get_types(MINIMAL)
+    fork_config = ChainForkConfig(ALL_FORKS_AT_GENESIS, MINIMAL)
+    pre = interop_genesis_state(fork_config, t.phase0, N, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        ALL_FORKS_AT_GENESIS, bytes(pre.genesis_validators_root), MINIMAL
+    )
+    state = upgrade_state_to_altair(config, MINIMAL, pre, t.altair)
+    state = upgrade_state_to_bellatrix(config, MINIMAL, state, t.bellatrix)
+    state = upgrade_state_to_capella(config, MINIMAL, state, t.capella)
+    # merge already complete at genesis: anchor the EL chain
+    state.latest_execution_payload_header.block_hash = EL_GENESIS_HASH
+    state.latest_execution_payload_header.timestamp = state.genesis_time
+    # validator 0 withdraws continuously (excess balance, eth1 credential)
+    state.validators[0].withdrawal_credentials = (
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xaa" * 20
+    )
+    state.balances[0] = MINIMAL.MAX_EFFECTIVE_BALANCE + 1_000_000
+    engine = ExecutionEngineMock(genesis_block_hash=EL_GENESIS_HASH)
+    chain = BeaconChain(
+        config,
+        t.capella,
+        state.copy(),
+        verifier=CpuBlsVerifier(),
+        execution_engine=engine,
+    )
+    return config, t.capella, chain, engine
+
+
+def _sign_and_import(config, types, chain, block):
+    domain = config.get_domain(DOMAIN_BEACON_PROPOSER, block.slot)
+    sig = _sk(block.proposer_index).sign(
+        compute_signing_root(block.hash_tree_root(), domain)
+    )
+    signed = types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+    return chain.process_block(signed, verify_signatures=True)
+
+
+def _sync_contributions(config, chain, types, slot, block_root):
+    """Full-participation contributions for `block_root` into the pool."""
+    from lodestar_tpu.params import SYNC_COMMITTEE_SUBNET_COUNT
+
+    cached = chain.head_state
+    domain = config.get_domain(DOMAIN_SYNC_COMMITTEE, slot, slot // SPE)
+    root = compute_signing_root(block_root, domain)
+    pk_to_idx = cached.epoch_ctx.pubkey_to_index
+    pubkeys = list(cached.state.current_sync_committee.pubkeys)
+    sub_size = MINIMAL.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    for sub in range(SYNC_COMMITTEE_SUBNET_COUNT):
+        sub_keys = pubkeys[sub * sub_size : (sub + 1) * sub_size]
+        sigs = [_sk(pk_to_idx[bytes(pk)]).sign(root) for pk in sub_keys]
+        chain.sync_contribution_pool.add(
+            types.SyncCommitteeContribution(
+                slot=slot,
+                beacon_block_root=block_root,
+                subcommittee_index=sub,
+                aggregation_bits=[True] * sub_size,
+                signature=bls.aggregate_signatures(sigs).to_bytes(),
+            )
+        )
+
+
+def _randao_reveal(config, chain, slot):
+    from lodestar_tpu.state_transition import process_slots
+
+    pre = chain.head_state.copy()
+    if slot > pre.state.slot:
+        process_slots(pre, chain.types, slot)
+    proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+    return (
+        _sk(proposer)
+        .sign(_epoch_signing_root(slot // SPE, config.get_domain(DOMAIN_RANDAO, slot)))
+        .to_bytes()
+    )
+
+
+def test_capella_chain_produces_and_imports_payload_blocks(capella_chain):
+    config, types, chain, engine = capella_chain
+    start_balance_v0 = int(chain.head_state.flat.balances[0])
+    for slot in range(1, SPE + 1):
+        parent_root = chain.head_root
+        _sync_contributions(config, chain, types, max(slot, 1) - 1, parent_root)
+        randao = _randao_reveal(config, chain, slot)
+        block = chain.produce_block(slot, randao)
+        # a real (non-default) payload rides every block
+        assert bytes(block.body.execution_payload.block_hash) != b"\x00" * 32
+        _sign_and_import(config, types, chain, block)
+    head = chain.head_state
+    assert head.state.slot == SPE
+    assert head.fork == ForkName.capella
+    # EL followed the beacon head
+    assert engine.head == bytes(
+        head.state.latest_execution_payload_header.block_hash
+    )
+    # withdrawals swept validator 0's excess down
+    assert int(head.flat.balances[0]) <= start_balance_v0
+    assert head.state.next_withdrawal_index > 0
+    # sync aggregates were included with full participation
+    head_block = chain.blocks[chain.head_root]
+    assert all(head_block.message.body.sync_aggregate.sync_committee_bits)
+
+
+def test_invalid_payload_rejected(capella_chain):
+    config, types, chain, engine = capella_chain
+    slot = chain.head_state.state.slot + 1
+    randao = _randao_reveal(config, chain, slot)
+    block = chain.produce_block(slot, randao)
+    engine.invalid_hashes.add(bytes(block.body.execution_payload.block_hash))
+    with pytest.raises(Exception, match="payload"):
+        _sign_and_import(config, types, chain, block)
+    engine.invalid_hashes.clear()
+
+
+def test_prepare_next_slot_scheduler(capella_chain):
+    config, types, chain, engine = capella_chain
+    slot = chain.head_state.state.slot
+    chain.prepare_next_slot.on_slot(slot)
+    prepared = chain.prepare_next_slot.get_prepared(slot + 1)
+    assert prepared is not None
+    assert prepared.state.slot == slot + 1
+    # the engine has a building session kicked off for the next slot
+    assert engine._building
