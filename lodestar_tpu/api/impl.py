@@ -133,14 +133,22 @@ class BeaconApiImpl:
 
     def getBlockV2(self, params, query, body):
         _, signed = self._resolve_block(params["block_id"])
-        return {"version": "phase0", "data": signed.to_obj()}
+        version = self.config.get_fork_name_at_slot(signed.message.slot)
+        return {"version": version, "data": signed.to_obj()}
 
     def getBlockRoot(self, params, query, body):
         root, _ = self._resolve_block(params["block_id"])
         return {"root": "0x" + root.hex()}
 
     def publishBlock(self, params, query, body):
-        signed = self.types.SignedBeaconBlock.from_obj(body)
+        # decode with the fork's container for the block's slot (the wire
+        # shape changes across forks)
+        from ..types import get_types
+
+        slot = int(body["message"]["slot"])
+        fork = self.config.get_fork_name_at_slot(slot)
+        types = get_types(self.config.preset).by_fork.get(fork, self.types)
+        signed = types.SignedBeaconBlock.from_obj(body)
         self.chain.process_block(signed)
         return None
 
@@ -199,34 +207,63 @@ class BeaconApiImpl:
     # -- validator -----------------------------------------------------------
 
     def getAttesterDuties(self, params, query, body):
-        if self.validator_service is None:
-            raise ApiError(503, "validator service not wired")
+        """Committee assignments for the requested validator indices,
+        computed from the head epoch context (reference
+        getAttesterDuties → getCommitteeAssignments)."""
         epoch = int(params["epoch"])
         wanted = {int(i) for i in body} if body else None
-        duties = self.validator_service.get_attester_duties(epoch)
+        st = self.chain.head_state
+        ctx = st.epoch_ctx
+        spe = self.config.preset.SLOTS_PER_EPOCH
         out = []
-        for d in duties:
-            if wanted is None or d.validator_index in wanted:
-                out.append(
-                    {
-                        "pubkey": "0x" + d.pubkey.hex(),
-                        "validator_index": str(d.validator_index),
-                        "committee_index": str(d.committee_index),
-                        "committee_length": str(d.committee_length),
-                        "slot": str(d.slot),
-                    }
-                )
+        try:
+            count = ctx.get_committee_count_per_slot(epoch)
+        except ValueError:
+            raise ApiError(400, f"epoch {epoch} outside cached shuffling range")
+        for slot in range(epoch * spe, (epoch + 1) * spe):
+            for cidx in range(count):
+                committee = ctx.get_beacon_committee(slot, cidx)
+                for pos, vidx in enumerate(committee):
+                    vidx = int(vidx)
+                    if wanted is not None and vidx not in wanted:
+                        continue
+                    out.append(
+                        {
+                            "pubkey": "0x" + bytes(st.flat.pubkeys[vidx]).hex(),
+                            "validator_index": str(vidx),
+                            "committee_index": str(cidx),
+                            "committee_length": str(len(committee)),
+                            "committees_at_slot": str(count),
+                            "validator_committee_index": str(pos),
+                            "slot": str(slot),
+                        }
+                    )
         return out
 
     def getProposerDuties(self, params, query, body):
-        ctx = self.chain.head_state.epoch_ctx
+        st = self.chain.head_state
         epoch = int(params["epoch"])
         spe = self.config.preset.SLOTS_PER_EPOCH
-        if epoch != ctx.current_epoch:
-            raise ApiError(400, "only current epoch supported")
+        if epoch != st.epoch_ctx.current_epoch:
+            # duties may be requested before the head crosses the epoch
+            # boundary: advance a copy (reference: regen + proposer cache;
+            # the prepared next-slot state usually makes this cheap)
+            prepared = self.chain.prepare_next_slot.get_prepared(
+                epoch * spe, self.chain.head_root
+            )
+            if prepared is not None:
+                st = prepared
+            elif epoch == st.epoch_ctx.current_epoch + 1:
+                from ..state_transition import process_slots
+
+                st = st.copy()
+                process_slots(st, self.types, epoch * spe)
+            else:
+                raise ApiError(400, f"epoch {epoch} not derivable from head")
+        ctx = st.epoch_ctx
         out = []
         for i, proposer in enumerate(ctx.proposers):
-            pk = self.chain.head_state.flat.pubkeys[proposer]
+            pk = st.flat.pubkeys[proposer]
             out.append(
                 {
                     "pubkey": "0x" + bytes(pk).hex(),
@@ -240,7 +277,8 @@ class BeaconApiImpl:
         slot = int(params["slot"])
         reveal = bytes.fromhex(query.get("randao_reveal", "")[2:])
         block = self.chain.produce_block(slot, randao_reveal=reveal)
-        return {"version": "phase0", "data": block.to_obj()}
+        version = self.config.get_fork_name_at_slot(slot)
+        return {"version": version, "data": block.to_obj()}
 
     def produceAttestationData(self, params, query, body):
         slot = int(query["slot"])
@@ -285,7 +323,62 @@ class BeaconApiImpl:
             self.chain.on_aggregated_attestation(agg, agg.data.hash_tree_root())
         return None
 
+    def getLiveness(self, params, query, body):
+        """Per-epoch liveness from the seen-caches (reference: lodestar's
+        /eth/v1/validator/liveness used by doppelganger protection)."""
+        epoch = int(params["epoch"])
+        out = []
+        for idx in body or []:
+            idx = int(idx)
+            live = self.chain.seen_attesters.is_known(epoch, idx)
+            if not live:
+                spe = self.config.preset.SLOTS_PER_EPOCH
+                live = any(
+                    self.chain.seen_block_proposers.is_known(slot, idx)
+                    for slot in range(epoch * spe, (epoch + 1) * spe)
+                )
+            out.append({"index": str(idx), "is_live": live})
+        return out
+
+    # -- light client (reference routes/lightclient.ts over the chain's
+    # LightClientServer) ------------------------------------------------------
+
+    def getLightClientBootstrap(self, params, query, body):
+        root = bytes.fromhex(params["block_root"].removeprefix("0x"))
+        boot = self.chain.light_client_server.get_bootstrap(root)
+        if boot is None:
+            raise ApiError(404, "no bootstrap for block root")
+        return boot.to_obj()
+
+    def getLightClientUpdatesByRange(self, params, query, body):
+        start = int(query.get("start_period", 0))
+        count = min(int(query.get("count", 1)), 128)
+        return [u.to_obj() for u in self.chain.light_client_server.get_updates(start, count)]
+
+    def getLightClientFinalityUpdate(self, params, query, body):
+        update = getattr(self.chain.light_client_server, "latest_finality_update", None)
+        if update is None:
+            raise ApiError(404, "no finality update available")
+        return update.to_obj()
+
+    def getLightClientOptimisticUpdate(self, params, query, body):
+        update = getattr(self.chain.light_client_server, "latest_optimistic_update", None)
+        if update is None:
+            raise ApiError(404, "no optimistic update available")
+        return update.to_obj()
+
     # -- debug ---------------------------------------------------------------
+
+    def getStateV2(self, params, query, body):
+        """Full SSZ state, hex-wrapped in JSON (reference serves
+        application/octet-stream; same bytes either way). Checkpoint sync
+        downloads its anchor through this route."""
+        st = self._resolve_state(params["state_id"])
+        st.sync_flat()
+        return {
+            "version": st.fork,
+            "ssz": "0x" + type(st.state).ssz_type.serialize(st.state).hex(),
+        }
 
     def getDebugChainHeadsV2(self, params, query, body):
         out = []

@@ -46,8 +46,15 @@ API_ROUTES: list[Route] = [
     Route("produceAttestationData", "GET", "/eth/v1/validator/attestation_data"),
     Route("getAggregatedAttestation", "GET", "/eth/v1/validator/aggregate_attestation"),
     Route("publishAggregateAndProofs", "POST", "/eth/v1/validator/aggregate_and_proofs"),
+    Route("getLiveness", "POST", "/eth/v1/validator/liveness/{epoch}"),
     # debug (routes/debug.ts)
     Route("getDebugChainHeadsV2", "GET", "/eth/v2/debug/beacon/heads"),
+    Route("getStateV2", "GET", "/eth/v2/debug/beacon/states/{state_id}"),
+    # light client (routes/lightclient.ts)
+    Route("getLightClientBootstrap", "GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}"),
+    Route("getLightClientUpdatesByRange", "GET", "/eth/v1/beacon/light_client/updates"),
+    Route("getLightClientFinalityUpdate", "GET", "/eth/v1/beacon/light_client/finality_update"),
+    Route("getLightClientOptimisticUpdate", "GET", "/eth/v1/beacon/light_client/optimistic_update"),
 ]
 
 ROUTES_BY_ID = {r.operation_id: r for r in API_ROUTES}

@@ -17,9 +17,12 @@ from .routes import match_route
 
 
 class BeaconApiServer:
-    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0, matcher=None):
+        """`matcher(method, path) -> (route, params)`: defaults to the
+        beacon route table; the keymanager server passes its own."""
         self.impl = impl
         impl_ref = impl
+        match = matcher if matcher is not None else match_route
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
@@ -27,7 +30,7 @@ class BeaconApiServer:
 
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
-                route, params = match_route(method, parsed.path)
+                route, params = match(method, parsed.path)
                 if route is None:
                     return self._send(404, {"message": "route not found"})
                 query = dict(parse_qsl(parsed.query))
@@ -64,6 +67,9 @@ class BeaconApiServer:
 
             def do_POST(self):
                 self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None

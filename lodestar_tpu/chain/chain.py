@@ -405,29 +405,33 @@ class BeaconChain:
             return None
         built = self.execution_engine.get_payload(payload_id)
 
-        # engines return either a _MockPayload-like object or an engine-API
-        # JSON dict (ExecutionEngineHttp) — normalize per field
+        # engines return either a _MockPayload-like object (snake_case
+        # attributes) or engine-API JSON (camelCase, hex quantities)
+        from ..execution.engine import engine_json_field
+
         def got(name, default=None):
-            if isinstance(built, dict):
-                return built.get(name, default)
-            return getattr(built, name, default)
+            return engine_json_field(built, name, default)
 
         fields = dict(
             parent_hash=_as_bytes(got("parent_hash", b"\x00" * 32)),
             fee_recipient=_as_bytes(got("fee_recipient", fee_recipient)),
             state_root=_as_bytes(got("state_root", b"\x00" * 32)),
             receipts_root=_as_bytes(got("receipts_root", b"\x00" * 32)),
+            logs_bloom=_as_bytes(got("logs_bloom", b"\x00" * 256)),
             prev_randao=_as_bytes(got("prev_randao", attributes.prev_randao)),
-            block_number=int(got("block_number", 0)),
-            gas_limit=int(got("gas_limit", 30_000_000)),
-            gas_used=int(got("gas_used", 0)),
-            timestamp=int(got("timestamp", attributes.timestamp)),
-            base_fee_per_gas=int(got("base_fee_per_gas", 7)),
+            block_number=_as_int(got("block_number", 0)),
+            gas_limit=_as_int(got("gas_limit", 30_000_000)),
+            gas_used=_as_int(got("gas_used", 0)),
+            timestamp=_as_int(got("timestamp", attributes.timestamp)),
+            extra_data=_as_bytes(got("extra_data", b"")),
+            base_fee_per_gas=_as_int(got("base_fee_per_gas", 7)),
             block_hash=_as_bytes(got("block_hash", b"\x00" * 32)),
             transactions=[_as_bytes(tx) for tx in got("transactions", []) or []],
         )
         if pre.is_capella:
-            fields["withdrawals"] = list(got("withdrawals", []) or [])
+            fields["withdrawals"] = [
+                _as_withdrawal(types, w) for w in got("withdrawals", []) or []
+            ]
         return types.ExecutionPayload(**fields)
 
     @property
@@ -472,6 +476,25 @@ def _as_bytes(value) -> bytes:
     if isinstance(value, str):
         return bytes.fromhex(value[2:] if value.startswith("0x") else value)
     return bytes(value)
+
+
+def _as_int(value) -> int:
+    """Engine JSON uses hex-quantity strings ("0x1"); mocks use ints."""
+    if isinstance(value, str):
+        return int(value, 16) if value.startswith("0x") else int(value)
+    return int(value)
+
+
+def _as_withdrawal(types, w):
+    """Engine JSON withdrawal dict (camelCase hex) or an SSZ Withdrawal."""
+    if isinstance(w, dict):
+        return types.Withdrawal(
+            index=_as_int(w.get("index", 0)),
+            validator_index=_as_int(w.get("validatorIndex", w.get("validator_index", 0))),
+            address=_as_bytes(w.get("address", b"\x00" * 20)),
+            amount=_as_int(w.get("amount", 0)),
+        )
+    return w
 
 
 def _anchor_block_root(state) -> bytes:

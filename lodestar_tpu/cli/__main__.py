@@ -6,7 +6,10 @@ from __future__ import annotations
 import argparse
 import sys
 
+from .beacon import add_beacon_parser
 from .dev import add_dev_parser
+from .lightclient import add_lightclient_parser
+from .validator import add_validator_parser
 
 
 def main(argv=None) -> int:
@@ -15,6 +18,9 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
     add_dev_parser(sub)
+    add_beacon_parser(sub)
+    add_validator_parser(sub)
+    add_lightclient_parser(sub)
     args = parser.parse_args(argv)
     return args.func(args)
 

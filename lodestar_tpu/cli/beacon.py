@@ -1,0 +1,148 @@
+"""`beacon` command: run a beacon node.
+
+Reference: `cli/src/cmds/beacon/handler.ts:25` — config from flags, db at
+the datadir, anchor state via the checkpoint-sync / db-resume / genesis
+decision tree (`initBeaconState.ts`), then `BeaconNode.init` and a clock
+loop until interrupted.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+
+from ..config.beacon_config import BeaconConfig, ChainForkConfig
+from ..config.chain_config import MAINNET_CHAIN_CONFIG, MINIMAL_CHAIN_CONFIG
+from ..db import BeaconDb
+from ..db.controller import FileDb, MemoryDb
+from ..node import BeaconNode, NodeOptions, init_beacon_state
+from ..params.presets import MAINNET, MINIMAL
+from ..state_transition import interop_genesis_state
+from ..types import get_types
+from ..utils.logger import get_logger
+
+
+def _fetch_checkpoint_state(url: str) -> tuple[str, bytes]:
+    """(fork_name, ssz_bytes) of a finalized state over the debug SSZ route
+    (reference: fetchWeakSubjectivityState from --checkpointSyncUrl)."""
+    from urllib.parse import urlparse
+
+    from ..api.client import BeaconApiClient
+
+    parsed = urlparse(url if "//" in url else f"http://{url}")
+    client = BeaconApiClient(parsed.hostname, parsed.port or 80)
+    data = client.getStateV2("finalized")
+    return data["version"], bytes.fromhex(data["ssz"].removeprefix("0x"))
+
+
+def run_beacon(args) -> int:
+    log = get_logger("beacon")
+    if args.network == "minimal-dev":
+        preset, chain_config = MINIMAL, MINIMAL_CHAIN_CONFIG
+    else:
+        preset, chain_config = MAINNET, MAINNET_CHAIN_CONFIG
+    types_all = get_types(preset)
+    fork_config = ChainForkConfig(chain_config, preset)
+
+    # anchor decision tree
+    checkpoint_bytes = None
+    checkpoint_fork = "phase0"
+    genesis_state = None
+    if args.checkpoint_sync_url:
+        log.info("checkpoint sync from %s", args.checkpoint_sync_url)
+        checkpoint_fork, checkpoint_bytes = _fetch_checkpoint_state(
+            args.checkpoint_sync_url
+        )
+    db_controller = FileDb(args.datadir) if args.datadir else MemoryDb()
+    probe_db = BeaconDb(types_all.phase0, db_controller)
+    if checkpoint_bytes is None and args.genesis_validators:
+        genesis_state = interop_genesis_state(
+            fork_config,
+            types_all.phase0,
+            args.genesis_validators,
+            genesis_time=args.genesis_time or int(time.time()),
+        )
+    state, origin = init_beacon_state(
+        fork_config,
+        types_all,
+        probe_db,
+        checkpoint_state_bytes=checkpoint_bytes,
+        checkpoint_fork=checkpoint_fork,
+        genesis_state=genesis_state,
+    )
+    from lodestar_tpu.node.init_state import _fork_of_state
+
+    types = types_all.by_fork[_fork_of_state(state)]
+    config = BeaconConfig(chain_config, bytes(state.genesis_validators_root), preset)
+    log.info("anchor: %s (slot %d)", origin, state.slot)
+
+    engine = None
+    if args.execution == "mock":
+        from ..execution.engine import ExecutionEngineMock
+
+        engine = ExecutionEngineMock()
+    elif args.execution:
+        from ..execution.engine import ExecutionEngineHttp
+
+        host, _, port = args.execution.rpartition(":")
+        secret = bytes.fromhex(args.jwt_secret) if args.jwt_secret else b"\x00" * 32
+        engine = ExecutionEngineHttp(host or "127.0.0.1", int(port), secret)
+
+    node = BeaconNode.init(
+        config,
+        types,
+        state,
+        NodeOptions(
+            db_controller=db_controller,  # datadir-backed, persists restarts
+            rest=args.rest,
+            rest_port=args.rest_port,
+            metrics=args.metrics,
+            metrics_port=args.metrics_port,
+            tpu_verifier=args.tpu_verifier,
+            execution_engine=engine,
+        ),
+    )
+
+    stop = {"flag": False}
+
+    def _sigint(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sigint)
+
+    genesis_time = state.genesis_time
+    spt = config.SECONDS_PER_SLOT
+    try:
+        last_slot = -1
+        deadline = time.time() + args.run_seconds if args.run_seconds else None
+        while not stop["flag"]:
+            now = time.time()
+            if deadline and now >= deadline:
+                break
+            slot = max(0, int(now - genesis_time) // spt)
+            if slot != last_slot:
+                node.on_clock_slot(slot)
+                last_slot = slot
+            time.sleep(min(0.2, spt / 10))
+        return 0
+    finally:
+        node.close()
+        log.info("node stopped; state persisted")
+
+
+def add_beacon_parser(sub) -> None:
+    p = sub.add_parser("beacon", help="run a beacon node")
+    p.add_argument("--network", default="minimal-dev", choices=["minimal-dev", "mainnet"])
+    p.add_argument("--datadir", default=None, help="persistent db path (default: memory)")
+    p.add_argument("--checkpoint-sync-url", default=None, help="trusted Beacon API for weak-subjectivity anchor")
+    p.add_argument("--genesis-validators", type=int, default=0, help="interop genesis with N validators")
+    p.add_argument("--genesis-time", type=int, default=0)
+    p.add_argument("--rest", action="store_true")
+    p.add_argument("--rest-port", type=int, default=5052)
+    p.add_argument("--metrics", action="store_true")
+    p.add_argument("--metrics-port", type=int, default=8008)
+    p.add_argument("--execution", default=None, help='"mock" or host:port of an EL engine API')
+    p.add_argument("--jwt-secret", default=None, help="hex engine-API JWT secret")
+    p.add_argument("--tpu-verifier", action="store_true")
+    p.add_argument("--run-seconds", type=float, default=0, help="exit after N seconds (0 = forever)")
+    p.set_defaults(func=run_beacon)

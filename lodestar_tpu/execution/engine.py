@@ -138,6 +138,68 @@ class ExecutionEngineMock:
         return payload
 
 
+def payload_to_engine_json(payload) -> dict:
+    """SSZ ExecutionPayload container → engine-API JSON (camelCase, 0x-hex,
+    hex-quantity numbers) — reference serializeExecutionPayload."""
+    out = {
+        "parentHash": "0x" + bytes(payload.parent_hash).hex(),
+        "feeRecipient": "0x" + bytes(payload.fee_recipient).hex(),
+        "stateRoot": "0x" + bytes(payload.state_root).hex(),
+        "receiptsRoot": "0x" + bytes(payload.receipts_root).hex(),
+        "logsBloom": "0x" + bytes(payload.logs_bloom).hex(),
+        "prevRandao": "0x" + bytes(payload.prev_randao).hex(),
+        "blockNumber": hex(payload.block_number),
+        "gasLimit": hex(payload.gas_limit),
+        "gasUsed": hex(payload.gas_used),
+        "timestamp": hex(payload.timestamp),
+        "extraData": "0x" + bytes(payload.extra_data).hex(),
+        "baseFeePerGas": hex(payload.base_fee_per_gas),
+        "blockHash": "0x" + bytes(payload.block_hash).hex(),
+        "transactions": ["0x" + bytes(tx).hex() for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [
+            {
+                "index": hex(w.index),
+                "validatorIndex": hex(w.validator_index),
+                "address": "0x" + bytes(w.address).hex(),
+                "amount": hex(w.amount),
+            }
+            for w in payload.withdrawals
+        ]
+    return out
+
+
+_ENGINE_KEY_MAP = {
+    "parent_hash": "parentHash",
+    "fee_recipient": "feeRecipient",
+    "state_root": "stateRoot",
+    "receipts_root": "receiptsRoot",
+    "logs_bloom": "logsBloom",
+    "prev_randao": "prevRandao",
+    "block_number": "blockNumber",
+    "gas_limit": "gasLimit",
+    "gas_used": "gasUsed",
+    "timestamp": "timestamp",
+    "extra_data": "extraData",
+    "base_fee_per_gas": "baseFeePerGas",
+    "block_hash": "blockHash",
+    "transactions": "transactions",
+    "withdrawals": "withdrawals",
+}
+
+
+def engine_json_field(built, snake_name: str, default=None):
+    """Field from an engine get_payload result: mock payload objects use
+    snake_case attributes, engine JSON uses camelCase keys."""
+    if isinstance(built, dict):
+        camel = _ENGINE_KEY_MAP.get(snake_name, snake_name)
+        if camel in built:
+            return built[camel]
+        return built.get(snake_name, default)
+    return getattr(built, snake_name, default)
+
+
 def _jwt_hs256(secret: bytes) -> str:
     """Engine-API JWT: HS256, iat claim (reference uses jwt-simple)."""
     b64 = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=")
@@ -184,8 +246,14 @@ class ExecutionEngineHttp:
             raise RuntimeError(f"{method}: {resp['error']}")
         return resp["result"]
 
-    def notify_new_payload(self, payload_json: dict) -> ExecutePayloadStatus:
-        result = self._call("engine_newPayloadV1", [payload_json])
+    def notify_new_payload(self, payload) -> ExecutePayloadStatus:
+        """Accepts an SSZ ExecutionPayload container or a pre-built engine
+        JSON dict."""
+        payload_json = (
+            payload if isinstance(payload, dict) else payload_to_engine_json(payload)
+        )
+        version = "V2" if "withdrawals" in payload_json else "V1"
+        result = self._call(f"engine_newPayload{version}", [payload_json])
         return ExecutePayloadStatus(result["status"])
 
     def notify_forkchoice_update(

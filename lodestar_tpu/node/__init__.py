@@ -1,0 +1,10 @@
+"""BeaconNode: the composition root.
+
+Reference: `beacon-node/src/node/nodejs.ts:127-270` — `BeaconNode.init()`
+wires db → metrics → chain → network → sync → api → servers, and `close()`
+persists caches; `node/notifier.ts` logs per-slot status lines.
+"""
+
+from .node import BeaconNode, NodeOptions  # noqa: F401
+from .init_state import init_beacon_state  # noqa: F401
+from .notifier import NodeNotifier  # noqa: F401

@@ -1,0 +1,145 @@
+"""BeaconNode composition root.
+
+Reference: `beacon-node/src/node/nodejs.ts:127-270` — wiring order
+db.start → metrics → chain → network → sync → api server → metrics server;
+`close()` persists the chain state back to the db (nodejs.ts:275-290).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..api import BeaconApiServer
+from ..api.impl import BeaconApiImpl
+from ..chain import BeaconChain, CpuBlsVerifier
+from ..db import BeaconDb
+from ..db.controller import FileDb, MemoryDb
+from ..metrics import MetricsServer, create_beacon_metrics
+from ..utils.logger import get_logger
+from .init_state import persist_state
+from .notifier import NodeNotifier
+
+
+@dataclass
+class NodeOptions:
+    """Reference: IBeaconNodeOptions (`node/options.ts`) — the flag tree the
+    CLI maps 1:1 onto."""
+
+    datadir: str | None = None  # None → in-memory db
+    db_controller: object | None = None  # pre-opened controller wins over datadir
+    rest: bool = True
+    rest_port: int = 0
+    metrics: bool = False
+    metrics_port: int = 0
+    tpu_verifier: bool = False
+    execution_engine: object | None = None
+    notifier_interval_slots: int = 1
+
+
+class BeaconNode:
+    """Owns every service; `BeaconNode.init(...)` is the only constructor
+    path (reference pattern)."""
+
+    def __init__(self):
+        raise TypeError("use BeaconNode.init()")
+
+    @classmethod
+    def init(cls, config, types, anchor_state, opts: NodeOptions | None = None):
+        self = object.__new__(cls)
+        opts = opts or NodeOptions()
+        self.opts = opts
+        self.config = config
+        self.types = types
+        self.log = get_logger("node")
+
+        # 1. db
+        if opts.db_controller is not None:
+            controller = opts.db_controller
+        else:
+            controller = FileDb(opts.datadir) if opts.datadir else MemoryDb()
+        self.db = BeaconDb(types, controller)
+
+        # 2. metrics
+        self.metrics = create_beacon_metrics()
+
+        # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread)
+        if opts.tpu_verifier:
+            from ..chain.bls_verifier import DeviceBlsVerifier
+
+            verifier = DeviceBlsVerifier()
+        else:
+            verifier = CpuBlsVerifier()
+        self.chain = BeaconChain(
+            config,
+            types,
+            anchor_state,
+            verifier=verifier,
+            db=self.db,
+            execution_engine=opts.execution_engine,
+        )
+
+        # 4. network + sync are attached by the caller once a transport
+        # exists (dev mode runs networkless, like reference dev w/o peers)
+        self.peers = []
+        self.sync = None
+
+        # 5. servers
+        self.api_server = None
+        self.metrics_server = None
+        if opts.rest:
+            impl = BeaconApiImpl(config, types, self.chain)
+            self.api_server = BeaconApiServer(impl, port=opts.rest_port)
+            self.api_server.start()
+            self.log.info("REST API on :%d", self.api_server.port)
+        if opts.metrics:
+            self.metrics_server = MetricsServer(
+                self.metrics.registry, port=opts.metrics_port
+            )
+            self.metrics_server.start()
+            self.log.info("metrics on :%d", self.metrics_server.port)
+
+        self.notifier = NodeNotifier(self, opts.notifier_interval_slots)
+        return self
+
+    # -- slot driving --------------------------------------------------------
+
+    def on_clock_slot(self, slot: int) -> None:
+        """Per-slot housekeeping: clock, fork-choice time, prepared state,
+        metrics, status line."""
+        self.chain.clock.set_slot(slot)
+        self.chain.fork_choice.update_time(slot)
+        self.chain.prepare_next_slot.on_slot(slot)
+        m = self.metrics
+        m.head_slot.set(self.chain.head_state.state.slot)
+        m.current_justified_epoch.set(self.chain.justified_checkpoint[0])
+        m.finalized_epoch.set(self.chain.finalized_checkpoint[0])
+        self.notifier.on_slot(slot)
+
+    def run(self, slots: int, slot_time: float = 0.0, on_slot=None) -> None:
+        """Drive `slots` wall-clock slots (dev/test; production would follow
+        the genesis-anchored clock)."""
+        start = self.chain.head_state.state.slot
+        for slot in range(start + 1, start + slots + 1):
+            if on_slot is not None:
+                on_slot(slot)
+            self.on_clock_slot(slot)
+            if slot_time > 0:
+                time.sleep(slot_time)
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Persist the head state then stop servers (reference
+        BeaconNode.close → chain.persistToDisk)."""
+        try:
+            head = self.chain.head_state
+            head.sync_flat()
+            persist_state(self.db, head.state, head.fork)
+        except Exception as e:  # persist is best-effort on shutdown
+            self.log.error("state persist failed: %s", e)
+        if self.api_server:
+            self.api_server.close()
+        if self.metrics_server:
+            self.metrics_server.close()
+        self.db.close()

@@ -74,6 +74,31 @@ def make_types(p: Preset, phase0: SimpleNamespace, altair: SimpleNamespace) -> S
         + [("latest_execution_payload_header", ExecutionPayloadHeader.ssz_type)],
     )
 
+    # blinded flow (MEV builder API): the payload header replaces the payload
+    BlindedBeaconBlockBody = _container(
+        "BlindedBeaconBlockBody",
+        [
+            ("execution_payload_header", ExecutionPayloadHeader.ssz_type)
+            if n == "execution_payload"
+            else (n, t)
+            for n, t in BeaconBlockBody.fields
+        ],
+    )
+    BlindedBeaconBlock = _container(
+        "BlindedBeaconBlock",
+        [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BlindedBeaconBlockBody.ssz_type),
+        ],
+    )
+    SignedBlindedBeaconBlock = _container(
+        "SignedBlindedBeaconBlock",
+        [("message", BlindedBeaconBlock.ssz_type), ("signature", BLSSignature)],
+    )
+
     PowBlock = _container(
         "PowBlock",
         [

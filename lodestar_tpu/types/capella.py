@@ -91,6 +91,31 @@ def make_types(
         [("message", BeaconBlock.ssz_type), ("signature", BLSSignature)],
     )
 
+    # blinded body: the header sits exactly in the payload's field position
+    BlindedBeaconBlockBody = _container(
+        "BlindedBeaconBlockBody",
+        [
+            ("execution_payload_header", ExecutionPayloadHeader.ssz_type)
+            if n == "execution_payload"
+            else (n, t)
+            for n, t in BeaconBlockBody.fields
+        ],
+    )
+    BlindedBeaconBlock = _container(
+        "BlindedBeaconBlock",
+        [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", BlindedBeaconBlockBody.ssz_type),
+        ],
+    )
+    SignedBlindedBeaconBlock = _container(
+        "SignedBlindedBeaconBlock",
+        [("message", BlindedBeaconBlock.ssz_type), ("signature", BLSSignature)],
+    )
+
     state_fields = [
         (
             name,

@@ -13,3 +13,6 @@ transport-independent.
 from .store import ValidatorStore  # noqa: F401
 from .slashing_protection import SlashingProtection, SlashingError  # noqa: F401
 from .service import ValidatorService  # noqa: F401
+from .rest_service import RestValidatorService  # noqa: F401
+from .doppelganger import DoppelgangerService, DoppelgangerStatus  # noqa: F401
+from .external_signer import ExternalSignerClient, ExternalSignerServer  # noqa: F401

@@ -26,6 +26,9 @@ class ValidatorStore:
         self.config = config
         self.protection = slashing_protection
         self._keys: dict[bytes, bls.SecretKey] = {}
+        # pubkey → ExternalSignerClient (reference: remote signer support in
+        # validatorStore via externalSignerClient)
+        self._remote: dict[bytes, object] = {}
 
     # -- key management ------------------------------------------------------
 
@@ -34,18 +37,33 @@ class ValidatorStore:
         self._keys[pk] = sk
         return pk
 
+    def add_remote_key(self, pubkey: bytes, signer) -> bytes:
+        """Register a pubkey whose signatures come from an external signer
+        (reference: `externalSignerClient`)."""
+        self._remote[pubkey] = signer
+        return pubkey
+
+    def remove_key(self, pubkey: bytes) -> bool:
+        return (
+            self._keys.pop(pubkey, None) is not None
+            or self._remote.pop(pubkey, None) is not None
+        )
+
     def has_pubkey(self, pubkey: bytes) -> bool:
-        return pubkey in self._keys
+        return pubkey in self._keys or pubkey in self._remote
 
     @property
     def pubkeys(self) -> list[bytes]:
-        return list(self._keys)
+        return list(self._keys) + list(self._remote)
 
-    def _sk(self, pubkey: bytes) -> bls.SecretKey:
+    def _sign_root(self, pubkey: bytes, root: bytes) -> bytes:
         sk = self._keys.get(pubkey)
-        if sk is None:
-            raise KeyError(f"no secret key for {pubkey.hex()}")
-        return sk
+        if sk is not None:
+            return sk.sign(root).to_bytes()
+        signer = self._remote.get(pubkey)
+        if signer is not None:
+            return signer.sign(pubkey, root)
+        raise KeyError(f"no signer for {pubkey.hex()}")
 
     # -- signing (each gate mirrors validatorStore) --------------------------
 
@@ -53,8 +71,8 @@ class ValidatorStore:
         domain = self.config.get_domain(DOMAIN_BEACON_PROPOSER, block.slot)
         root = compute_signing_root(block.hash_tree_root(), domain)
         self.protection.check_and_insert_block_proposal(pubkey, block.slot, root)
-        sig = self._sk(pubkey).sign(root)
-        return types.SignedBeaconBlock(message=block, signature=sig.to_bytes())
+        sig = self._sign_root(pubkey, root)
+        return types.SignedBeaconBlock(message=block, signature=sig)
 
     def sign_attestation(self, pubkey: bytes, data) -> bytes:
         spe = self.config.preset.SLOTS_PER_EPOCH
@@ -67,27 +85,27 @@ class ValidatorStore:
         self.protection.check_and_insert_attestation(
             pubkey, data.source.epoch, data.target.epoch, root
         )
-        return self._sk(pubkey).sign(root).to_bytes()
+        return self._sign_root(pubkey, root)
 
     def sign_randao(self, pubkey: bytes, slot: int) -> bytes:
         epoch = slot // self.config.preset.SLOTS_PER_EPOCH
         domain = self.config.get_domain(DOMAIN_RANDAO, slot)
         root = compute_signing_root(uint64.hash_tree_root(epoch), domain)
-        return self._sk(pubkey).sign(root).to_bytes()
+        return self._sign_root(pubkey, root)
 
     def sign_selection_proof(self, pubkey: bytes, slot: int) -> bytes:
         domain = self.config.get_domain(DOMAIN_SELECTION_PROOF, slot)
         root = compute_signing_root(uint64.hash_tree_root(slot), domain)
-        return self._sk(pubkey).sign(root).to_bytes()
+        return self._sign_root(pubkey, root)
 
     def sign_aggregate_and_proof(self, pubkey: bytes, types, agg_and_proof):
         domain = self.config.get_domain(
             DOMAIN_AGGREGATE_AND_PROOF, agg_and_proof.aggregate.data.slot
         )
         root = compute_signing_root(agg_and_proof.hash_tree_root(), domain)
-        sig = self._sk(pubkey).sign(root)
+        sig = self._sign_root(pubkey, root)
         return types.SignedAggregateAndProof(
-            message=agg_and_proof, signature=sig.to_bytes()
+            message=agg_and_proof, signature=sig
         )
 
     def is_aggregator(self, slot: int, committee_size: int, pubkey: bytes) -> bool:
