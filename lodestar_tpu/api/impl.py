@@ -373,7 +373,9 @@ class BeaconApiImpl:
         """Full SSZ state, hex-wrapped in JSON (reference serves
         application/octet-stream; same bytes either way). Checkpoint sync
         downloads its anchor through this route."""
-        st = self._resolve_state(params["state_id"])
+        # serialize a private copy: sync_flat() writes flat columns back into
+        # the state, and the live head may be mid-transition on another thread
+        st = self._resolve_state(params["state_id"]).copy()
         st.sync_flat()
         return {
             "version": st.fork,

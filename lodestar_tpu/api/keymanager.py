@@ -61,6 +61,14 @@ class KeymanagerApiImpl:
         passwords = body.get("passwords", [])
         if len(passwords) not in (1, len(keystores)):
             raise ApiError(400, "passwords must match keystores")
+        # EIP-3076 interchange travels with the keys so migrated validators
+        # keep their anti-slashing history (keymanager spec importKeystores)
+        interchange = body.get("slashing_protection")
+        if interchange:
+            obj = _json.loads(interchange) if isinstance(interchange, str) else interchange
+            slashing = getattr(self.store, "protection", None)
+            if slashing is not None:
+                slashing.import_interchange(obj)
         statuses = []
         for i, raw in enumerate(keystores):
             ks = _json.loads(raw) if isinstance(raw, str) else raw

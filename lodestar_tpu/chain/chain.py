@@ -403,7 +403,8 @@ class BeaconChain:
         )
         if payload_id is None:
             return None
-        built = self.execution_engine.get_payload(payload_id)
+        fork = "capella" if pre.is_capella else "bellatrix"
+        built = self.execution_engine.get_payload(payload_id, fork=fork)
 
         # engines return either a _MockPayload-like object (snake_case
         # attributes) or engine-API JSON (camelCase, hex quantities)

@@ -30,7 +30,7 @@ def _fetch_checkpoint_state(url: str) -> tuple[str, bytes]:
     from ..api.client import BeaconApiClient
 
     parsed = urlparse(url if "//" in url else f"http://{url}")
-    client = BeaconApiClient(parsed.hostname, parsed.port or 80)
+    client = BeaconApiClient(parsed.hostname, parsed.port or 5052)
     data = client.getStateV2("finalized")
     return data["version"], bytes.fromhex(data["ssz"].removeprefix("0x"))
 

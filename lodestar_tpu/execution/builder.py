@@ -27,18 +27,12 @@ class BuilderApiClient:
         self.timeout = timeout
 
     def _request(self, method: str, path: str, body=None):
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            raw = resp.read()
-            if resp.status >= 400:
-                raise BuilderApiError(f"{resp.status}: {raw[:200]!r}")
-            return json.loads(raw) if raw else None
-        finally:
-            conn.close()
+        from ..utils.http import json_http_request
+
+        return json_http_request(
+            self.host, self.port, method, path, body,
+            timeout=self.timeout, error_cls=BuilderApiError,
+        )
 
     def check_status(self) -> bool:
         try:

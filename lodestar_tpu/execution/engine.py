@@ -50,7 +50,7 @@ class IExecutionEngine(Protocol):
         attributes: PayloadAttributes | None = None,
     ) -> str | None: ...
 
-    def get_payload(self, payload_id: str): ...
+    def get_payload(self, payload_id: str, fork: str = "bellatrix"): ...
 
 
 @dataclass
@@ -131,7 +131,7 @@ class ExecutionEngineMock:
         )
         return payload_id
 
-    def get_payload(self, payload_id: str) -> _MockPayload:
+    def get_payload(self, payload_id: str, fork: str = "bellatrix") -> _MockPayload:
         payload = self._building.pop(payload_id, None)
         if payload is None:
             raise ValueError(f"unknown payload id {payload_id}")
@@ -288,5 +288,12 @@ class ExecutionEngineHttp:
         payload_id = result.get("payloadId")
         return payload_id
 
-    def get_payload(self, payload_id: str) -> dict:
-        return self._call("engine_getPayloadV1", [payload_id])
+    def get_payload(self, payload_id: str, fork: str = "bellatrix") -> dict:
+        """engine_getPayloadV1 pre-capella; V2 (which wraps the payload as
+        {executionPayload, blockValue} and carries withdrawals) after."""
+        if fork in ("phase0", "altair", "bellatrix"):
+            return self._call("engine_getPayloadV1", [payload_id])
+        result = self._call("engine_getPayloadV2", [payload_id])
+        if isinstance(result, dict) and "executionPayload" in result:
+            return result["executionPayload"]
+        return result

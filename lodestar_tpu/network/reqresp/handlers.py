@@ -80,6 +80,41 @@ class ReqRespHandlers:
             out += encode_response_chunk(signed.serialize())
         return bytes(out)
 
+    # -- light client server protocols (reference reqresp/types.ts:55-67) ---
+
+    def _lc_server(self):
+        return getattr(self.chain, "light_client_server", None)
+
+    def on_light_client_bootstrap(self, block_root: bytes) -> bytes:
+        lc = self._lc_server()
+        bootstrap = lc.get_bootstrap(block_root) if lc is not None else None
+        if bootstrap is None:
+            return encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "no bootstrap")
+        return encode_response_chunk(bootstrap.serialize())
+
+    def on_light_client_updates_by_range(self, start_period: int, count: int) -> bytes:
+        lc = self._lc_server()
+        if lc is None or count < 1 or count > 128:
+            return encode_error_chunk(RespCode.INVALID_REQUEST, "bad range")
+        out = bytearray()
+        for update in lc.get_updates(start_period, count):
+            out += encode_response_chunk(update.serialize())
+        return bytes(out)
+
+    def on_light_client_finality_update(self) -> bytes:
+        lc = self._lc_server()
+        update = getattr(lc, "latest_finality_update", None) if lc is not None else None
+        if update is None:
+            return encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "none yet")
+        return encode_response_chunk(update.serialize())
+
+    def on_light_client_optimistic_update(self) -> bytes:
+        lc = self._lc_server()
+        update = getattr(lc, "latest_optimistic_update", None) if lc is not None else None
+        if update is None:
+            return encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "none yet")
+        return encode_response_chunk(update.serialize())
+
     def on_beacon_blocks_by_root(self, roots: list[bytes]) -> bytes:
         if len(roots) > MAX_REQUEST_BLOCKS:
             return encode_error_chunk(RespCode.INVALID_REQUEST, "too many roots")

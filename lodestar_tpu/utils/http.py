@@ -1,0 +1,36 @@
+"""Shared blocking JSON-over-HTTP request helper.
+
+One transport helper for every REST-ish client in the tree (beacon API,
+builder relay, external signer) so timeout/TLS/error-shape fixes land in
+one place. The reference splits these across cross-fetch wrappers; here a
+single function serves all blocking clients.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+
+def json_http_request(
+    host: str,
+    port: int,
+    method: str,
+    path: str,
+    body=None,
+    timeout: float = 10.0,
+    error_cls: type[Exception] = RuntimeError,
+):
+    """Issue one request, decode the JSON reply, raise `error_cls` on >=400."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        if resp.status >= 400:
+            raise error_cls(f"{resp.status}: {raw[:200]!r}")
+        return json.loads(raw) if raw else None
+    finally:
+        conn.close()

@@ -30,18 +30,12 @@ class ExternalSignerClient:
         self.timeout = timeout
 
     def _request(self, method: str, path: str, body=None):
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            raw = resp.read()
-            if resp.status >= 400:
-                raise ExternalSignerError(f"{resp.status}: {raw[:200]!r}")
-            return json.loads(raw) if raw else None
-        finally:
-            conn.close()
+        from ..utils.http import json_http_request
+
+        return json_http_request(
+            self.host, self.port, method, path, body,
+            timeout=self.timeout, error_cls=ExternalSignerError,
+        )
 
     def list_pubkeys(self) -> list[bytes]:
         keys = self._request("GET", "/api/v1/eth2/publicKeys") or []

@@ -44,17 +44,19 @@ class ValidatorStore:
         return pubkey
 
     def remove_key(self, pubkey: bytes) -> bool:
-        return (
-            self._keys.pop(pubkey, None) is not None
-            or self._remote.pop(pubkey, None) is not None
-        )
+        # pop BOTH maps: a pubkey registered as local and remote must lose
+        # every signing path, or a keymanager delete would report success
+        # while the remote path keeps signing
+        local = self._keys.pop(pubkey, None) is not None
+        remote = self._remote.pop(pubkey, None) is not None
+        return local or remote
 
     def has_pubkey(self, pubkey: bytes) -> bool:
         return pubkey in self._keys or pubkey in self._remote
 
     @property
     def pubkeys(self) -> list[bytes]:
-        return list(self._keys) + list(self._remote)
+        return list(dict.fromkeys(list(self._keys) + list(self._remote)))
 
     def _sign_root(self, pubkey: bytes, root: bytes) -> bytes:
         sk = self._keys.get(pubkey)

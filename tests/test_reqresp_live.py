@@ -1,0 +1,159 @@
+"""Req/Resp over the live secure transport: two real nodes, real TCP.
+
+Reference analog: `beacon-node/test/e2e/network/reqresp.test.ts` — two
+in-process nodes with real libp2p streams exchanging Status / blocks.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from lodestar_tpu.network.reqresp.handlers import ReqRespHandlers
+from lodestar_tpu.network.reqresp.service import RemotePeer, ReqRespService, RequestError
+from lodestar_tpu.network.transport import NodeIdentity, Transport
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60.0))
+
+
+def _make_chain_with_blocks(n_blocks=4):
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.params import DOMAIN_RANDAO
+    from lodestar_tpu.params.presets import MINIMAL
+    from lodestar_tpu.state_transition import interop_genesis_state, process_slots
+    from lodestar_tpu.state_transition.block import _epoch_signing_root
+    from lodestar_tpu.types import get_types
+    from tests.test_chain import _sign_block, _sk
+
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    chain = BeaconChain(config, types, state)
+    blocks = []
+    for slot in range(1, n_blocks + 1):
+        chain.clock.set_slot(slot)
+        trial = chain.head_state.copy()
+        if slot > trial.state.slot:
+            process_slots(trial, types, slot)
+        proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+        reveal = _sk(proposer).sign(
+            _epoch_signing_root(0, config.get_domain(DOMAIN_RANDAO, slot))
+        ).to_bytes()
+        block = chain.produce_block(slot, randao_reveal=reveal)
+        signed = _sign_block(config, types, block)
+        chain.process_block(signed, verify_signatures=False)
+        blocks.append(signed)
+    return config, types, chain, blocks
+
+
+@pytest.fixture(scope="module")
+def chain_env():
+    return _make_chain_with_blocks()
+
+
+async def _two_nodes(chain_env):
+    config, types, chain, blocks = chain_env
+    t_server = Transport(NodeIdentity.from_seed(b"server"))
+    t_client = Transport(NodeIdentity.from_seed(b"client"))
+    server_svc = ReqRespService(
+        t_server, ReqRespHandlers(config, types, chain), types
+    )
+    client_svc = ReqRespService(
+        t_client, ReqRespHandlers(config, types, chain), types
+    )
+    host, port = await t_server.listen()
+    await t_client.dial(host, port)
+    return t_server, t_client, server_svc, client_svc
+
+
+def test_status_exchange_over_wire(chain_env):
+    async def main():
+        t_server, t_client, _, client_svc = await _two_nodes(chain_env)
+        status = await client_svc.status(t_server.peer_id)
+        assert status.head_slot == chain_env[2].head_state.state.slot
+        assert bytes(status.head_root) == chain_env[2].head_root
+        await t_client.close()
+        await t_server.close()
+
+    run(main())
+
+
+def test_blocks_by_range_and_root_over_wire(chain_env):
+    async def main():
+        _, types, chain, blocks = chain_env
+        t_server, t_client, _, client_svc = await _two_nodes(chain_env)
+        got = await client_svc.beacon_blocks_by_range(t_server.peer_id, 1, 10)
+        assert [b.message.slot for b in got] == [1, 2, 3, 4]
+        root = blocks[1].message.hash_tree_root()
+        got2 = await client_svc.beacon_blocks_by_root(t_server.peer_id, [root])
+        assert len(got2) == 1 and got2[0].message.hash_tree_root() == root
+        await t_client.close()
+        await t_server.close()
+
+    run(main())
+
+
+def test_ping_metadata_goodbye(chain_env):
+    async def main():
+        t_server, t_client, _, client_svc = await _two_nodes(chain_env)
+        seq = await client_svc.ping(t_server.peer_id, 7)
+        assert seq == 0
+        md = await client_svc.metadata(t_server.peer_id)
+        assert md.seq_number == 0
+        await client_svc.goodbye(t_server.peer_id, reason=1)
+        await t_client.close()
+        await t_server.close()
+
+    run(main())
+
+
+def test_request_rate_limit_rejects_spam(chain_env):
+    async def main():
+        t_server, t_client, server_svc, client_svc = await _two_nodes(chain_env)
+        server_svc.request_rate.limit = 3
+        ok, rejected = 0, 0
+        for _ in range(6):
+            try:
+                await client_svc.ping(t_server.peer_id)
+                ok += 1
+            except RequestError as e:
+                assert e.code in ("RESOURCE_UNAVAILABLE", "EMPTY_RESPONSE")
+                rejected += 1
+        assert ok == 3 and rejected == 3
+        await t_client.close()
+        await t_server.close()
+
+    run(main())
+
+
+def test_remote_peer_sync_adapter(chain_env):
+    """RemotePeer drives the async client from a sync worker thread —
+    the IPeer surface range-sync consumes."""
+    _, types, chain, blocks = chain_env
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    try:
+        async def setup():
+            return await _two_nodes(chain_env)
+
+        t_server, t_client, _, client_svc = asyncio.run_coroutine_threadsafe(
+            setup(), loop
+        ).result(30)
+        peer = RemotePeer(client_svc, t_server.peer_id, loop)
+        status = peer.status()
+        assert status.head_slot == 4
+        got = peer.beacon_blocks_by_range(1, 2)
+        assert [b.message.slot for b in got] == [1, 2]
+        asyncio.run_coroutine_threadsafe(t_client.close(), loop).result(10)
+        asyncio.run_coroutine_threadsafe(t_server.close(), loop).result(10)
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
