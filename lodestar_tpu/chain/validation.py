@@ -185,3 +185,183 @@ def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
         return ValidationResult(GossipAction.IGNORE, "cannot build signature set")
 
     return ValidationResult(GossipAction.ACCEPT)
+
+
+def validate_gossip_aggregate_and_proof(chain, types, signed_agg) -> ValidationResult:
+    """The beacon_aggregate_and_proof ladder (reference
+    `chain/validation/aggregateAndProof.ts`): aggregator membership +
+    selection proof + aggregate signature, all via the batch verifier."""
+    from ..config.beacon_config import compute_signing_root
+    from ..params import DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_SELECTION_PROOF
+    from ..ssz.hashing import sha256
+    from ..state_transition.signature_sets import _pk
+
+    p = chain.preset
+    agg = signed_agg.message
+    attestation = agg.aggregate
+    data = attestation.data
+
+    # [IGNORE] propagation slot range
+    clock_slot = chain.clock.current_slot
+    if not (data.slot <= clock_slot <= data.slot + p.SLOTS_PER_EPOCH):
+        return ValidationResult(GossipAction.IGNORE, "slot out of propagation range")
+
+    # [REJECT] has participants
+    bits = list(attestation.aggregation_bits)
+    if not any(bits):
+        return ValidationResult(GossipAction.REJECT, "empty aggregation bits")
+
+    # [REJECT] target epoch consistency (spec: target.epoch must match the
+    # epoch of data.slot)
+    if int(data.target.epoch) != st_util.compute_epoch_at_slot(
+        int(data.slot), p.SLOTS_PER_EPOCH
+    ):
+        return ValidationResult(GossipAction.REJECT, "target epoch mismatch")
+
+    # [IGNORE] duplicate (aggregator, target) / non-strict superset check
+    target_epoch = int(data.target.epoch)
+    if chain.seen_aggregators.is_known(target_epoch, int(agg.aggregator_index)):
+        return ValidationResult(GossipAction.IGNORE, "aggregator already seen")
+    data_root = data.hash_tree_root()
+    if chain.seen_aggregated.is_known_superset(data_root, bits):
+        return ValidationResult(GossipAction.IGNORE, "aggregate already covered")
+
+    # [IGNORE] unknown head block
+    head_block_root = bytes(data.beacon_block_root)
+    if not chain.fork_choice.has_block(head_block_root):
+        return ValidationResult(GossipAction.IGNORE, "unknown beacon_block_root")
+
+    try:
+        target_state = chain.regen.get_checkpoint_state(
+            target_epoch, bytes(data.target.root)
+        )
+    except Exception:
+        return ValidationResult(GossipAction.IGNORE, "target state unavailable")
+    ctx = target_state.epoch_ctx
+
+    # [REJECT] committee index + bits length
+    if data.index >= ctx.get_committee_count_per_slot(target_epoch):
+        return ValidationResult(GossipAction.REJECT, "committee index out of range")
+    committee = ctx.get_beacon_committee(data.slot, data.index)
+    if len(bits) != len(committee):
+        return ValidationResult(GossipAction.REJECT, "wrong bits length")
+
+    # [REJECT] aggregator is a committee member
+    aggregator_index = int(agg.aggregator_index)
+    if aggregator_index not in [int(i) for i in committee]:
+        return ValidationResult(GossipAction.REJECT, "aggregator not in committee")
+
+    # [REJECT] selection proof selects this validator as aggregator
+    # (spec is_aggregator: hash(proof) mod max(1, len//TARGET) == 0)
+    modulo = max(1, len(committee) // 16)  # TARGET_AGGREGATORS_PER_COMMITTEE=16
+    if int.from_bytes(sha256(bytes(agg.selection_proof))[:8], "little") % modulo != 0:
+        return ValidationResult(GossipAction.REJECT, "not selected as aggregator")
+
+    # [REJECT] three signatures, one batch: selection proof, aggregate-and-
+    # proof envelope, and the aggregate attestation itself
+    from ..state_transition.signature_sets import attestation_signature_set
+    from ..bls import api as bls
+
+    sel_domain = target_state.config.get_domain(DOMAIN_SELECTION_PROOF, data.slot)
+    slot_bytes = int(data.slot).to_bytes(8, "little") + b"\x00" * 24
+    from ..ssz.hashing import merkleize_chunks
+
+    slot_root = merkleize_chunks([slot_bytes], 1)
+    sel_set = bls.SignatureSet(
+        pubkey=_pk(target_state, aggregator_index),
+        message=compute_signing_root(slot_root, sel_domain),
+        signature=bytes(agg.selection_proof),
+    )
+    env_domain = target_state.config.get_domain(DOMAIN_AGGREGATE_AND_PROOF, data.slot)
+    env_set = bls.SignatureSet(
+        pubkey=_pk(target_state, aggregator_index),
+        message=compute_signing_root(agg.hash_tree_root(), env_domain),
+        signature=bytes(signed_agg.signature),
+    )
+    att_set = attestation_signature_set(target_state, types, attestation)
+    if not chain.bls.verify_signature_sets([sel_set, env_set, att_set]):
+        return ValidationResult(GossipAction.REJECT, "invalid signatures")
+
+    chain.seen_aggregators.add(target_epoch, aggregator_index)
+    chain.seen_aggregated.add(target_epoch, data_root, bits)
+    return ValidationResult(GossipAction.ACCEPT, data_root=data_root)
+
+
+def validate_gossip_voluntary_exit(chain, types, signed_exit) -> ValidationResult:
+    """Reference `chain/validation/voluntaryExit.ts`: first-seen per
+    validator, then full state validity incl. signature."""
+    from ..state_transition.signature_sets import voluntary_exit_signature_set
+
+    index = int(signed_exit.message.validator_index)
+    if index in chain.op_pool.voluntary_exits:
+        return ValidationResult(GossipAction.IGNORE, "exit already known")
+    head = chain.head_state
+    if index >= len(head.flat.pubkeys):
+        return ValidationResult(GossipAction.REJECT, "unknown validator")
+    v = head.state.validators[index]
+    cur_epoch = head.epoch_ctx.current_epoch
+    from ..params.presets import FAR_FUTURE_EPOCH
+
+    if int(v.exit_epoch) != FAR_FUTURE_EPOCH:
+        return ValidationResult(GossipAction.REJECT, "already exiting")
+    if not (int(v.activation_epoch) <= cur_epoch):
+        return ValidationResult(GossipAction.REJECT, "not active")
+    if cur_epoch < int(signed_exit.message.epoch):
+        return ValidationResult(GossipAction.REJECT, "exit epoch in future")
+    if cur_epoch < int(v.activation_epoch) + chain.config.chain.SHARD_COMMITTEE_PERIOD:
+        return ValidationResult(GossipAction.REJECT, "validator too young")
+    if not chain.bls.verify_signature_sets(
+        [voluntary_exit_signature_set(head, signed_exit)]
+    ):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    return ValidationResult(GossipAction.ACCEPT)
+
+
+def validate_gossip_proposer_slashing(chain, types, slashing) -> ValidationResult:
+    """Reference `chain/validation/proposerSlashing.ts`."""
+    from ..state_transition.signature_sets import proposer_slashing_signature_sets
+
+    index = int(slashing.signed_header_1.message.proposer_index)
+    if index in chain.op_pool.proposer_slashings:
+        return ValidationResult(GossipAction.IGNORE, "slashing already known")
+    h1, h2 = slashing.signed_header_1.message, slashing.signed_header_2.message
+    if int(h1.slot) != int(h2.slot) or int(h1.proposer_index) != int(h2.proposer_index):
+        return ValidationResult(GossipAction.REJECT, "headers not slashable")
+    if h1.hash_tree_root() == h2.hash_tree_root():
+        return ValidationResult(GossipAction.REJECT, "identical headers")
+    head = chain.head_state
+    if index >= len(head.flat.pubkeys):
+        return ValidationResult(GossipAction.REJECT, "unknown proposer")
+    v = head.state.validators[index]
+    if bool(v.slashed):
+        return ValidationResult(GossipAction.IGNORE, "already slashed")
+    if not chain.bls.verify_signature_sets(
+        proposer_slashing_signature_sets(head, slashing)
+    ):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    return ValidationResult(GossipAction.ACCEPT)
+
+
+def validate_gossip_attester_slashing(chain, types, slashing) -> ValidationResult:
+    """Reference `chain/validation/attesterSlashing.ts`."""
+    from ..state_transition.block import is_slashable_attestation_data
+    from ..state_transition.signature_sets import attester_slashing_signature_sets
+
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        return ValidationResult(GossipAction.REJECT, "not slashable")
+    ind1 = {int(i) for i in a1.attesting_indices}
+    ind2 = {int(i) for i in a2.attesting_indices}
+    head = chain.head_state
+    slashable = {
+        i
+        for i in ind1 & ind2
+        if i < len(head.flat.pubkeys) and not bool(head.state.validators[i].slashed)
+    }
+    if not slashable:
+        return ValidationResult(GossipAction.IGNORE, "no new slashable indices")
+    if not chain.bls.verify_signature_sets(
+        attester_slashing_signature_sets(head, slashing)
+    ):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    return ValidationResult(GossipAction.ACCEPT)

@@ -9,7 +9,6 @@ the role the reference's builder test doubles play.
 from __future__ import annotations
 
 import json
-import http.client
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 

@@ -1,0 +1,181 @@
+"""Gossip handlers: topic → bounded validation queue → chain.
+
+Reference: `network/gossip/handlers/index.ts:76+` (decode, validate,
+act on ACCEPT, penalize on REJECT) and the per-topic-type queues of
+`network/gossip/validation/queue.ts:10-22`:
+
+    beacon_attestation            LIFO  maxLen 24,576  concurrency 64
+    beacon_aggregate_and_proof    LIFO   maxLen 5,120  concurrency 16
+    beacon_block                  FIFO   maxLen 1,024  concurrency 1
+    (everything else)             FIFO   maxLen 4,096  concurrency 16
+
+Queues keep slow validation (BLS, regen) from starving the router;
+LIFO prefers fresh attestations under backlog, exactly like the
+reference. The decoded-object cache avoids double-decode between the
+router's validator callback and the post-accept side effects.
+"""
+
+from __future__ import annotations
+
+from ...chain.validation import (
+    GossipAction,
+    validate_gossip_aggregate_and_proof,
+    validate_gossip_attestation,
+    validate_gossip_attester_slashing,
+    validate_gossip_block,
+    validate_gossip_proposer_slashing,
+    validate_gossip_voluntary_exit,
+)
+from ...utils.logger import get_logger
+from ...utils.queue import JobItemQueue, QueueType
+from .encoding import decode_message
+from .gossipsub import ValidationResult
+from .topic import GossipType, parse_topic
+
+log = get_logger("gossip-handlers")
+
+QUEUE_OPTS: dict[GossipType, tuple[QueueType, int, int]] = {
+    GossipType.beacon_attestation: (QueueType.LIFO, 24_576, 64),
+    GossipType.beacon_aggregate_and_proof: (QueueType.LIFO, 5_120, 16),
+    GossipType.beacon_block: (QueueType.FIFO, 1_024, 1),
+}
+DEFAULT_QUEUE = (QueueType.FIFO, 4_096, 16)
+
+_ACTION_TO_RESULT = {
+    GossipAction.ACCEPT: ValidationResult.ACCEPT,
+    GossipAction.IGNORE: ValidationResult.IGNORE,
+    GossipAction.REJECT: ValidationResult.REJECT,
+}
+
+
+class GossipHandlers:
+    """Owns the validation queues and the per-type handler logic."""
+
+    def __init__(self, config, types, chain, verify_signatures: bool = True):
+        self.config = config
+        self.types = types
+        self.chain = chain
+        self.verify_signatures = verify_signatures
+        self.queues: dict[GossipType, JobItemQueue] = {}
+        for gtype in GossipType:
+            qt, max_len, conc = QUEUE_OPTS.get(gtype, DEFAULT_QUEUE)
+            self.queues[gtype] = JobItemQueue(
+                self._process,
+                max_length=max_len,
+                max_concurrency=conc,
+                queue_type=qt,
+                name=f"gossip.{gtype.value}",
+            )
+
+    def register(self, router) -> None:
+        """Install one validator per topic type on the gossipsub router
+        (prefix-matched, so every fork digest and subnet is covered)."""
+        async def validator(topic_str: str, wire: bytes) -> ValidationResult:
+            try:
+                topic = parse_topic(topic_str)
+            except ValueError:
+                return ValidationResult.REJECT
+            queue = self.queues[topic.type]
+            try:
+                return await queue.push((topic, wire))
+            except Exception:
+                return ValidationResult.IGNORE  # queue full / closed
+
+        # the router prefix-matches on "/eth2/" — one validator for all
+        router.validators["/eth2/"] = validator
+
+    # -- queue processor -----------------------------------------------------
+
+    async def _process(self, item) -> ValidationResult:
+        topic, wire = item
+        try:
+            ssz = decode_message(wire)
+        except ValueError:
+            return ValidationResult.REJECT
+        from ...ssz import DeserializationError
+
+        try:
+            return self._handle(topic, ssz)
+        except DeserializationError:
+            return ValidationResult.REJECT  # undecodable object = bad peer
+        except Exception as e:  # noqa: BLE001 — a handler bug must not REJECT
+            log.debug(f"handler error on {topic.type.value}: {e}")
+            return ValidationResult.IGNORE
+
+    def _handle(self, topic, ssz: bytes) -> ValidationResult:
+        chain, types = self.chain, self.types
+        t = topic.type
+
+        if t is GossipType.beacon_block:
+            signed = types.SignedBeaconBlock.deserialize(ssz)
+            result = validate_gossip_block(chain, types, signed)
+            if result.action is GossipAction.ACCEPT:
+                chain.seen_block_proposers.add(
+                    int(signed.message.slot), int(signed.message.proposer_index)
+                )
+                try:
+                    chain.process_block(
+                        signed, verify_signatures=self.verify_signatures
+                    )
+                except Exception as e:
+                    log.debug(f"gossip block import failed: {e}")
+                    return ValidationResult.REJECT
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.beacon_attestation:
+            att = types.Attestation.deserialize(ssz)
+            result = validate_gossip_attestation(chain, types, att, topic.subnet)
+            if result.action is GossipAction.ACCEPT:
+                chain.on_gossip_attestation(att, result.data_root)
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.beacon_aggregate_and_proof:
+            signed_agg = types.SignedAggregateAndProof.deserialize(ssz)
+            result = validate_gossip_aggregate_and_proof(chain, types, signed_agg)
+            if result.action is GossipAction.ACCEPT:
+                chain.on_aggregated_attestation(
+                    signed_agg.message.aggregate, result.data_root
+                )
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.voluntary_exit:
+            signed_exit = types.SignedVoluntaryExit.deserialize(ssz)
+            result = validate_gossip_voluntary_exit(chain, types, signed_exit)
+            if result.action is GossipAction.ACCEPT:
+                chain.op_pool.add_voluntary_exit(signed_exit)
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.proposer_slashing:
+            slashing = types.ProposerSlashing.deserialize(ssz)
+            result = validate_gossip_proposer_slashing(chain, types, slashing)
+            if result.action is GossipAction.ACCEPT:
+                chain.op_pool.add_proposer_slashing(slashing)
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.attester_slashing:
+            slashing = types.AttesterSlashing.deserialize(ssz)
+            result = validate_gossip_attester_slashing(chain, types, slashing)
+            if result.action is GossipAction.ACCEPT:
+                chain.op_pool.add_attester_slashing(slashing)
+            return _ACTION_TO_RESULT[result.action]
+
+        if t is GossipType.sync_committee:
+            if not hasattr(types, "SyncCommitteeMessage"):
+                return ValidationResult.IGNORE
+            msg = types.SyncCommitteeMessage.deserialize(ssz)
+            pool = getattr(chain, "sync_committee_pool", None)
+            if pool is not None and topic.subnet is not None:
+                pool.add(msg, topic.subnet, 0)
+            return ValidationResult.ACCEPT
+
+        if t is GossipType.sync_committee_contribution_and_proof:
+            if not hasattr(types, "SignedContributionAndProof"):
+                return ValidationResult.IGNORE
+            signed = types.SignedContributionAndProof.deserialize(ssz)
+            pool = getattr(chain, "sync_contribution_pool", None)
+            if pool is not None:
+                pool.add(signed.message.contribution)
+            return ValidationResult.ACCEPT
+
+        # light-client updates: served, not consumed, by full nodes
+        return ValidationResult.IGNORE

@@ -1,0 +1,223 @@
+"""Network facade: transport + gossip + reqresp + peers + subnets.
+
+Reference: `network/network.ts:39` — the `Network` class owns
+`Eth2Gossipsub`, `ReqResp`, `PeerManager`, attnets/syncnets services and
+the fork-transition topic logic (`subscribeGossipCoreTopics` :225, and
+subscribing both fork digests ±epochs around a scheduled fork,
+network.ts:39-110).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..utils.logger import get_logger
+from .gossip.gossipsub import Gossipsub, GossipsubService
+from .gossip.handlers import GossipHandlers
+from .gossip.score import PeerScoreParams, ethereum_topic_params
+from .gossip.topic import SUBNET_TYPES, GossipTopic, GossipType, stringify_topic
+from .peers import PeerAction, PeerManager, ScoreState
+from .reqresp.handlers import ReqRespHandlers
+from .reqresp.service import RemotePeer, ReqRespService
+from .subnets import AttnetsService
+from .transport import NodeIdentity, Transport
+
+log = get_logger("network")
+
+CORE_TOPICS = [
+    GossipType.beacon_block,
+    GossipType.beacon_aggregate_and_proof,
+    GossipType.voluntary_exit,
+    GossipType.proposer_slashing,
+    GossipType.attester_slashing,
+]
+
+HEARTBEAT_SEC = 2.0
+
+
+class Network:
+    """One object the node wires in; start() listens, connect() dials."""
+
+    def __init__(
+        self,
+        config,
+        types,
+        chain,
+        identity: NodeIdentity | None = None,
+        verify_signatures: bool = True,
+        subscribe_all_subnets: bool = False,
+    ):
+        self.config = config
+        self.types = types
+        self.chain = chain
+        self.transport = Transport(identity)
+        self.peer_id = self.transport.peer_id
+        self.peer_manager = PeerManager()
+        self.subscribe_all_subnets = subscribe_all_subnets
+
+        # gossip: Ethereum score params for the topics we will join
+        score_params = PeerScoreParams()
+        self.gossip = Gossipsub(score_params)
+        self.gossip_service = GossipsubService(self.transport, self.gossip)
+        self.gossip_handlers = GossipHandlers(
+            config, types, chain, verify_signatures=verify_signatures
+        )
+        self.gossip_handlers.register(self.gossip)
+        self._score_params = score_params
+
+        # reqresp
+        self.reqresp_handlers = ReqRespHandlers(config, types, chain)
+        self.reqresp = ReqRespService(
+            self.transport, self.reqresp_handlers, types, self.peer_manager
+        )
+
+        # subnets
+        node_id = bytes.fromhex(self.peer_id)
+        self.attnets = AttnetsService(node_id, config.preset.SLOTS_PER_EPOCH)
+
+        self._heartbeat_task: asyncio.Task | None = None
+        self.transport.on_connection.append(self._on_connection)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        addr = await self.transport.listen(host, port)
+        await self.subscribe_gossip_core_topics()
+        self.gossip.start_heartbeat()
+        self._heartbeat_task = asyncio.get_running_loop().create_task(
+            self._heartbeat_loop()
+        )
+        return addr
+
+    async def stop(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except asyncio.CancelledError:
+                pass
+        await self.gossip.stop()
+        for q in self.gossip_handlers.queues.values():
+            q.close()
+        await self.transport.close()
+
+    async def connect(self, host: str, port: int):
+        conn = await self.transport.dial(host, port)
+        return conn
+
+    # -- topic management ----------------------------------------------------
+
+    def _fork_digests_now(self) -> list[bytes]:
+        """Digests to subscribe: current fork, plus the next fork's digest
+        around a scheduled transition (reference network.ts fork logic)."""
+        epoch = self.chain.clock.current_epoch
+        return [
+            self.config.fork_digest(f)
+            for f in self.config.get_active_forks_around_epoch(epoch)
+        ]
+
+    async def subscribe_gossip_core_topics(self) -> None:
+        for digest in self._fork_digests_now():
+            for gtype in CORE_TOPICS:
+                topic_str = stringify_topic(GossipTopic(gtype, digest))
+                self._ensure_topic_params(topic_str)
+                await self.gossip.subscribe(topic_str)
+            subnets = (
+                range(64)
+                if self.subscribe_all_subnets
+                else sorted(self.attnets.active_subnets(self.chain.clock.current_epoch))
+            )
+            for subnet in subnets:
+                await self.subscribe_subnet(subnet, digest)
+
+    async def subscribe_subnet(self, subnet: int, digest: bytes | None = None) -> None:
+        digests = [digest] if digest is not None else self._fork_digests_now()
+        for d in digests:
+            topic = GossipTopic(GossipType.beacon_attestation, d, subnet)
+            await self.gossip.subscribe(stringify_topic(topic))
+            self._ensure_topic_params(stringify_topic(topic))
+
+    def _ensure_topic_params(self, topic_str: str) -> None:
+        if topic_str not in self._score_params.topics:
+            kind = topic_str.split("/")[3]
+            base = kind.rsplit("_", 1)[0] if kind.rsplit("_", 1)[-1].isdigit() else kind
+            self._score_params.topics[topic_str] = ethereum_topic_params(base)
+
+    async def publish_block(self, signed_block) -> int:
+        from .gossip.encoding import encode_message
+
+        digest = self.config.fork_digest(
+            self.config.get_fork_name_at_slot(int(signed_block.message.slot))
+        )
+        topic = stringify_topic(GossipTopic(GossipType.beacon_block, digest))
+        return await self.gossip.publish(topic, encode_message(signed_block.serialize()))
+
+    async def publish_attestation(self, attestation, subnet: int) -> int:
+        from .gossip.encoding import encode_message
+
+        digest = self.config.fork_digest(
+            self.config.get_fork_name_at_slot(int(attestation.data.slot))
+        )
+        topic = stringify_topic(
+            GossipTopic(GossipType.beacon_attestation, digest, subnet)
+        )
+        return await self.gossip.publish(topic, encode_message(attestation.serialize()))
+
+    async def publish_aggregate(self, signed_agg) -> int:
+        from .gossip.encoding import encode_message
+
+        digest = self.config.fork_digest(
+            self.config.get_fork_name_at_slot(
+                int(signed_agg.message.aggregate.data.slot)
+            )
+        )
+        topic = stringify_topic(
+            GossipTopic(GossipType.beacon_aggregate_and_proof, digest)
+        )
+        return await self.gossip.publish(topic, encode_message(signed_agg.serialize()))
+
+    # -- peers ---------------------------------------------------------------
+
+    def _on_connection(self, conn) -> None:
+        if not self.peer_manager.on_connect(
+            conn.peer_id, "outbound" if conn.initiator else "inbound"
+        ):
+            asyncio.get_running_loop().create_task(conn.close())
+            return
+        conn.on_close.append(lambda: self.peer_manager.on_disconnect(conn.peer_id))
+        asyncio.get_running_loop().create_task(self._status_handshake(conn.peer_id))
+
+    async def _status_handshake(self, peer_id: str) -> None:
+        try:
+            status = await self.reqresp.status(peer_id)
+            self.peer_manager.on_status(peer_id, status)
+        except Exception:
+            pass  # peers that never answer status get pruned by scoring
+
+    def sync_peers(self, loop: asyncio.AbstractEventLoop) -> list[RemotePeer]:
+        """RemotePeer views of all connected peers for the sync layer."""
+        return [
+            RemotePeer(self.reqresp, pid, loop)
+            for pid in self.transport.connections
+        ]
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HEARTBEAT_SEC)
+            try:
+                # feed gossip scores into the peer manager as app scores,
+                # then disconnect what it prunes
+                for pid in list(self.transport.connections):
+                    if self.peer_manager.scores.state(pid) != ScoreState.Healthy:
+                        continue
+                to_drop = self.peer_manager.heartbeat()
+                for pid in to_drop:
+                    conn = self.transport.connections.get(pid)
+                    if conn is not None:
+                        await self.reqresp.goodbye(pid)
+                        await conn.close()
+            except Exception as e:  # noqa: BLE001
+                log.debug(f"network heartbeat error: {e}")
+
+    def report_peer(self, peer_id: str, action: PeerAction) -> None:
+        self.peer_manager.report_peer(peer_id, action)

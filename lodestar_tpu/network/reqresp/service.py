@@ -89,7 +89,9 @@ class ReqRespService:
             self._penalize(peer_id, PeerAction.MidToleranceError)
             return
         try:
-            wire_req = await asyncio.wait_for(stream.read_all(), REQUEST_TIMEOUT)
+            wire_req = await asyncio.wait_for(
+                self._read_request_capped(stream), REQUEST_TIMEOUT
+            )
             response = self._respond(proto, wire_req)
         except Exception as e:  # malformed request
             log.debug(f"reqresp {proto.value} from {peer_id[:8]} failed: {e}")
@@ -100,6 +102,23 @@ class ReqRespService:
             await stream.close()
         except Exception:
             pass
+
+    # requests are tiny (Status=84B SSZ, ByRoot ≤ 32KiB of roots); anything
+    # bigger is hostile — cap buffering so a frame-pumping peer can't balloon
+    # server memory inside the request timeout
+    MAX_REQUEST_WIRE = 256 * 1024
+
+    async def _read_request_capped(self, stream) -> bytes:
+        chunks: list[bytes] = []
+        total = 0
+        while True:
+            chunk = await stream.read()
+            if chunk is None:
+                return b"".join(chunks)
+            total += len(chunk)
+            if total > self.MAX_REQUEST_WIRE:
+                raise ValueError("request exceeds size cap")
+            chunks.append(chunk)
 
     def _respond(self, proto: Protocol, wire_req: bytes) -> bytes:
         h = self.handlers
@@ -161,7 +180,8 @@ class ReqRespService:
             if first is None:
                 raise RequestError("EMPTY_RESPONSE")
             rest = await asyncio.wait_for(stream.read_all(), RESP_TIMEOUT)
-        except TimeoutError:
+        except (TimeoutError, asyncio.TimeoutError):
+            # asyncio.TimeoutError is a distinct class until 3.11
             self._penalize(peer_id, PeerAction.HighToleranceError)
             raise RequestError("RESP_TIMEOUT", proto.value) from None
         finally:

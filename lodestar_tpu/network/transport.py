@@ -317,16 +317,24 @@ class Stream:
             await self.conn._send_mux(self.stream_id, flag, b"")
         except TransportError:
             pass
+        if self._remote_closed:
+            self._forget()
 
     async def reset(self) -> None:
         if self._reset:
             return
         self._mark_reset()
+        self._forget()
         flag = _RESET_INITIATOR if self.initiator else _RESET_RECEIVER
         try:
             await self.conn._send_mux(self.stream_id, flag, b"")
         except TransportError:
             pass
+
+    def _forget(self) -> None:
+        """Drop the connection's registry entry (both fully-closed and
+        reset streams) so long-lived connections don't accumulate streams."""
+        self.conn.streams.pop((self.stream_id, self.initiator), None)
 
     def _mark_reset(self) -> None:
         self._reset = True
@@ -338,6 +346,8 @@ class Stream:
     def _on_remote_close(self) -> None:
         self._remote_closed = True
         self._inbox.put_nowait(None)
+        if self._local_closed:
+            self._forget()
 
 
 StreamHandler = Callable[[Stream], Awaitable[None]]
@@ -520,7 +530,12 @@ class Transport:
                 perform_handshake(self.identity, reader, writer, initiator=True),
                 timeout=10.0,
             )
-        except (HandshakeError, asyncio.TimeoutError) as e:
+        except (
+            HandshakeError,
+            asyncio.TimeoutError,
+            ConnectionError,
+            asyncio.IncompleteReadError,
+        ) as e:
             writer.close()
             raise HandshakeError(str(e)) from e
         return self._adopt(Connection(self, reader, writer, channel, peer_id, pub, True))

@@ -10,7 +10,6 @@ The bundled `ExternalSignerServer` plays the web3signer role for e2e tests
 from __future__ import annotations
 
 import json
-import http.client
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 

@@ -1,0 +1,282 @@
+"""Gossipsub v1.1: RPC codec, score function, mesh mechanics, and live
+multi-node propagation over the secure transport.
+
+Reference analogs: `@chainsafe/libp2p-gossipsub` unit tests +
+`beacon-node/test/e2e/network/gossipsub.test.ts` (two nodes exchanging
+gossip objects over real libp2p).
+"""
+
+import asyncio
+
+from lodestar_tpu.network.gossip.gossipsub import (
+    Gossipsub,
+    MessageCache,
+    TimedSet,
+    ValidationResult,
+)
+from lodestar_tpu.network.gossip.rpc import (
+    RPC,
+    ControlIHave,
+    ControlPrune,
+    decode_rpc,
+    encode_rpc,
+)
+from lodestar_tpu.network.gossip.score import (
+    PeerScore,
+    PeerScoreParams,
+    TopicScoreParams,
+    ethereum_topic_params,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60.0))
+
+
+# ---------------------------------------------------------------- RPC codec
+
+
+def test_rpc_roundtrip_all_sections():
+    rpc = RPC(
+        subscriptions=[(True, "/eth2/aabbccdd/beacon_block/ssz_snappy"), (False, "t2")],
+        messages=[("topicA", b"payload-1"), ("topicB", b"")],
+        ihave=[ControlIHave("topicA", [b"\x01" * 20, b"\x02" * 20])],
+        iwant=[b"\x03" * 20],
+        graft=["topicA"],
+        prune=[ControlPrune("topicB", 45)],
+    )
+    decoded = decode_rpc(encode_rpc(rpc))
+    assert decoded.subscriptions == rpc.subscriptions
+    assert decoded.messages == rpc.messages
+    assert decoded.ihave[0].topic == "topicA"
+    assert decoded.ihave[0].msg_ids == rpc.ihave[0].msg_ids
+    assert decoded.iwant == rpc.iwant
+    assert decoded.graft == ["topicA"]
+    assert decoded.prune[0].topic == "topicB" and decoded.prune[0].backoff_sec == 45
+
+
+def test_rpc_rejects_garbage():
+    import pytest
+
+    with pytest.raises(ValueError):
+        decode_rpc(b"\xff\x01\x02")
+
+
+# ---------------------------------------------------------------- mcache/seen
+
+
+def test_message_cache_windows_expire():
+    mc = MessageCache(gossip_windows=2, total=3)
+    mc.put(b"id1", "t", b"d1")
+    assert mc.gossip_ids("t") == [b"id1"]
+    mc.shift()
+    mc.put(b"id2", "t", b"d2")
+    assert set(mc.gossip_ids("t")) == {b"id1", b"id2"}
+    mc.shift()
+    mc.shift()  # id1 now outside gossip windows AND expired from history
+    assert mc.gossip_ids("t") == [b"id2"] or b"id1" not in mc.msgs
+    mc.shift()
+    assert mc.get(b"id2") is None
+
+
+def test_timed_set_expiry():
+    now = [0.0]
+    ts = TimedSet(ttl=10.0, time_fn=lambda: now[0])
+    assert ts.put(b"a") and not ts.put(b"a")
+    now[0] = 11.0
+    assert b"a" not in ts
+    assert ts.put(b"a")
+
+
+# ---------------------------------------------------------------- score
+
+
+def test_score_invalid_messages_quadratic_penalty():
+    now = [0.0]
+    params = PeerScoreParams(topics={"t": TopicScoreParams(topic_weight=1.0)})
+    score = PeerScore(params, time_fn=lambda: now[0])
+    score.add_peer("p1")
+    score.graft("p1", "t")
+    assert score.score("p1") >= 0
+    for _ in range(3):
+        score.reject_message("p1", "t")
+    # 9 * -100 (default invalid weight) dominates
+    assert score.score("p1") < -500
+
+
+def test_score_first_deliveries_reward_and_cap():
+    now = [0.0]
+    params = PeerScoreParams(
+        topics={"t": TopicScoreParams(topic_weight=1.0, first_message_deliveries_cap=5)}
+    )
+    score = PeerScore(params, time_fn=lambda: now[0])
+    score.add_peer("p1")
+    for _ in range(50):
+        score.deliver_message("p1", "t", first=True)
+    assert 0 < score.score("p1") <= 5 * 1.0 + 1e-9
+
+
+def test_score_retained_after_disconnect():
+    now = [0.0]
+    params = PeerScoreParams(topics={"t": TopicScoreParams(topic_weight=1.0)})
+    score = PeerScore(params, time_fn=lambda: now[0])
+    score.add_peer("bad")
+    score.reject_message("bad", "t")
+    before = score.score("bad")
+    assert before < 0
+    score.remove_peer("bad")
+    score.add_peer("bad")  # reconnect: penalty must survive
+    assert score.score("bad") == before
+
+
+def test_ethereum_topic_params_shape():
+    bb = ethereum_topic_params("beacon_block")
+    att = ethereum_topic_params("beacon_attestation")
+    assert bb.topic_weight > att.topic_weight
+    assert bb.invalid_message_deliveries_weight < 0
+
+
+# ---------------------------------------------------------------- router unit
+
+
+class _Pipe:
+    """Connect two routers in-memory."""
+
+    def __init__(self):
+        self.routers = {}
+
+    def add(self, name: str, router: Gossipsub):
+        self.routers[name] = router
+
+    def link(self, a: str, b: str, outbound_a=True):
+        ra, rb = self.routers[a], self.routers[b]
+
+        async def send_to_b(data: bytes):
+            await rb.on_rpc(a, data)
+
+        async def send_to_a(data: bytes):
+            await ra.on_rpc(b, data)
+
+        ra.add_peer(b, send_to_b, outbound=outbound_a)
+        rb.add_peer(a, send_to_a, outbound=not outbound_a)
+
+
+def test_mesh_forms_and_message_propagates():
+    async def main():
+        pipe = _Pipe()
+        routers = {n: Gossipsub() for n in ("a", "b", "c")}
+        for n, r in routers.items():
+            pipe.add(n, r)
+        pipe.link("a", "b")
+        pipe.link("b", "c")
+        got = []
+
+        for n, r in routers.items():
+            await r.subscribe("topic1")
+
+        async def tap(topic, data):
+            got.append(data)
+
+        routers["c"].on_message = tap
+        for r in routers.values():
+            await r.heartbeat()
+        # a publishes; c (two hops away) must receive via b's mesh forward
+        await routers["a"].publish("topic1", b"hello-mesh")
+        await asyncio.sleep(0)
+        assert got == [b"hello-mesh"]
+        # duplicate publish is suppressed by the seen cache
+        sent = await routers["a"].publish("topic1", b"hello-mesh")
+        assert sent == 0
+
+    run(main())
+
+
+def test_reject_validation_stops_propagation_and_penalizes():
+    async def main():
+        pipe = _Pipe()
+        a, b, c = Gossipsub(), Gossipsub(), Gossipsub()
+        pipe.add("a", a), pipe.add("b", b), pipe.add("c", c)
+        pipe.link("a", "b")
+        pipe.link("b", "c")
+        for r in (a, b, c):
+            await r.subscribe("t")
+            await r.heartbeat()
+
+        async def reject_all(topic, data):
+            return ValidationResult.REJECT
+
+        b.validators["t"] = reject_all
+        got = []
+
+        async def tap(topic, data):
+            got.append(data)
+
+        c.on_message = tap
+        b.score.params.topics["t"] = TopicScoreParams(topic_weight=1.0)
+        await a.publish("t", b"bad-message")
+        await asyncio.sleep(0)
+        assert got == []  # b refused to forward
+        assert b.score.score("a") < 0  # and penalized the sender
+
+    run(main())
+
+
+def test_ihave_iwant_recovery():
+    async def main():
+        pipe = _Pipe()
+        a, b = Gossipsub(), Gossipsub()
+        pipe.add("a", a), pipe.add("b", b)
+        # linked, subscribed, but NOT meshed (no heartbeat joins yet):
+        # direct publish only reaches mesh/flood targets — emulate a missed
+        # message by injecting into a's mcache alone
+        pipe.link("a", "b")
+        await a.subscribe("t")
+        # keep b OUT of a's mesh (prune backoff): IHAVE goes only to
+        # non-mesh topic peers — mesh members get the messages themselves
+        a.peers["b"].dont_send_until["t"] = 1e18
+        await b.subscribe("t")
+        a.mesh["t"].discard("b")  # drop any graft that raced the backoff
+        from lodestar_tpu.network.gossip.encoding import compute_msg_id
+
+        data = b"missed-message"
+        mid = compute_msg_id("t", data)
+        a.seen.put(mid)
+        a.mcache.put(mid, "t", data)
+        got = []
+
+        async def tap(topic, d):
+            got.append(d)
+
+        b.on_message = tap
+        # a's heartbeat emits IHAVE to b → b IWANTs → a sends the message
+        await a.heartbeat()
+        await asyncio.sleep(0)
+        assert got == [data]
+
+    run(main())
+
+
+def test_prune_backoff_respected():
+    async def main():
+        now = [0.0]
+        a = Gossipsub(time_fn=lambda: now[0])
+        sent = []
+
+        async def send(data):
+            sent.append(decode_rpc(data))
+
+        a.add_peer("p", send, outbound=True)
+        a.peers["p"].topics.add("t")
+        await a.subscribe("t")
+        # peer prunes us with a 60s backoff
+        await a.on_rpc("p", encode_rpc(RPC(prune=[ControlPrune("t", 60)])))
+        sent.clear()
+        await a.heartbeat()
+        grafts = [r for r in sent if r.graft]
+        assert not grafts  # must not re-graft during backoff
+        now[0] = 61.0
+        await a.heartbeat()
+        grafts = [r for r in sent if r.graft]
+        assert grafts  # backoff expired → graft again
+
+    run(main())
