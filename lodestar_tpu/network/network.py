@@ -33,6 +33,7 @@ CORE_TOPICS = [
 ]
 
 HEARTBEAT_SEC = 2.0
+DIAL_TIMEOUT = 5.0  # TCP connect + handshake, per dial attempt
 
 
 class Network:
@@ -75,19 +76,74 @@ class Network:
         node_id = bytes.fromhex(self.peer_id)
         self.attnets = AttnetsService(node_id, config.preset.SLOTS_PER_EPOCH)
 
+        self.discovery = None  # enabled via start(discovery=True)
+
         self._heartbeat_task: asyncio.Task | None = None
         self.transport.on_connection.append(self._on_connection)
 
     # -- lifecycle -----------------------------------------------------------
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+    async def start(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        discovery: bool = False,
+        bootnodes: list | None = None,
+        advertise_ip: str | None = None,
+    ) -> tuple[str, int]:
         addr = await self.transport.listen(host, port)
+        if discovery or bootnodes:
+            # the ENR must carry a dialable address — a wildcard bind is not
+            # one, so an explicit advertise_ip is required off-loopback
+            ip = advertise_ip or addr[0]
+            if ip in ("0.0.0.0", "::"):
+                log.warning("wildcard bind with no advertise_ip; ENR uses loopback")
+                ip = "127.0.0.1"
+            await self._start_discovery((ip, addr[1]), bootnodes or [], bind_host=host)
         await self.subscribe_gossip_core_topics()
         self.gossip.start_heartbeat()
         self._heartbeat_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop()
         )
         return addr
+
+    async def _start_discovery(
+        self, advertise_addr, bootnodes: list, bind_host: str | None = None
+    ) -> None:
+        from .discovery import ENR, Discovery
+
+        epoch = self.chain.clock.current_epoch
+        enr = ENR(
+            node_id=self.peer_id,
+            pubkey=self.transport.identity.public_bytes,
+            ip=advertise_addr[0],
+            tcp_port=advertise_addr[1],
+            udp_port=0,
+            fork_digest=self._fork_digests_now()[0],
+        )
+        attnets = self.attnets.enr_attnets(epoch)
+        self.discovery = Discovery(self.transport.identity, enr)
+        self.discovery.update_attnets(attnets)
+        self.discovery.on_discovered.append(self._on_discovered)
+        await self.discovery.start(bind_host or advertise_addr[0])
+        if bootnodes:
+            await self.discovery.bootstrap(bootnodes)
+
+    def _on_discovered(self, enr) -> None:
+        """Dial newly-discovered peers while below the connection target
+        (reference: PeerManager consuming discv5 discoveries); at target,
+        the heartbeat re-dials from the discovery table when slots free."""
+        if enr.node_id in self.transport.connections:
+            return
+        if len(self.transport.connections) >= self.peer_manager.target_peers:
+            return
+        asyncio.get_running_loop().create_task(self._dial_enr(enr))
+
+    async def _dial_enr(self, enr) -> None:
+        try:
+            await asyncio.wait_for(self.connect(enr.ip, enr.tcp_port), DIAL_TIMEOUT)
+        except Exception as e:
+            log.debug(f"dial {enr.node_id[:8]} failed: {e}")
 
     async def stop(self) -> None:
         if self._heartbeat_task is not None:
@@ -97,6 +153,8 @@ class Network:
             except asyncio.CancelledError:
                 pass
         await self.gossip.stop()
+        if self.discovery is not None:
+            self.discovery.stop()
         for q in self.gossip_handlers.queues.values():
             q.close()
         await self.transport.close()
@@ -205,11 +263,37 @@ class Network:
         while True:
             await asyncio.sleep(HEARTBEAT_SEC)
             try:
-                # feed gossip scores into the peer manager as app scores,
-                # then disconnect what it prunes
+                # below-target: dial peers known to discovery but not yet
+                # connected (reference: PeerManager discover-on-heartbeat).
+                # Dials are concurrent, time-capped tasks, at most enough to
+                # reach the target — a stale ENR must not stall the beat
+                if self.discovery is not None:
+                    want = self.peer_manager.target_peers - len(
+                        self.transport.connections
+                    )
+                    if want > 0:
+                        candidates = [
+                            enr
+                            for enr in self.discovery.table.all()
+                            if enr.node_id not in self.transport.connections
+                        ][:want]
+                        for enr in candidates:
+                            asyncio.get_running_loop().create_task(
+                                self._dial_enr(enr)
+                            )
+                # feed gossip scores into the peer manager: deep gossip
+                # negatives become actionable peer-manager penalties so the
+                # prune pass below disconnects/bans them
+                from .gossip.score import GRAYLIST_THRESHOLD, PUBLISH_THRESHOLD
+
                 for pid in list(self.transport.connections):
-                    if self.peer_manager.scores.state(pid) != ScoreState.Healthy:
-                        continue
+                    gscore = self.gossip.score.score(pid)
+                    if gscore <= GRAYLIST_THRESHOLD:
+                        self.peer_manager.report_peer(pid, PeerAction.Fatal)
+                    elif gscore <= PUBLISH_THRESHOLD:
+                        self.peer_manager.report_peer(
+                            pid, PeerAction.LowToleranceError
+                        )
                 to_drop = self.peer_manager.heartbeat()
                 for pid in to_drop:
                     conn = self.transport.connections.get(pid)

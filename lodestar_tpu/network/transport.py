@@ -555,8 +555,13 @@ class Transport:
             self.connections.pop(conn.peer_id, None)
 
     async def close(self) -> None:
+        # stop accepting, THEN close connections, THEN wait: since 3.12
+        # Server.wait_closed() also waits for accepted client connections,
+        # so any other order can hang on a connection that slips in (or on
+        # connections waiting for the server)
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
         for conn in list(self.connections.values()):
             await conn.close()
+        if self._server is not None:
+            await self._server.wait_closed()

@@ -1,0 +1,204 @@
+"""Discovery: ENR signing/encoding, routing table, live UDP lookups.
+
+Reference analog: discv5 usage in `network/peers/discover.ts` — bootstrap
+from bootnodes, iterative lookups populate the table, subnet-targeted
+queries filter by attnets bits.
+"""
+
+import asyncio
+
+from lodestar_tpu.network.discovery import (
+    ENR,
+    Discovery,
+    RoutingTable,
+    _distance,
+)
+from lodestar_tpu.network.transport import NodeIdentity
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60.0))
+
+
+def _identity(i: int) -> NodeIdentity:
+    return NodeIdentity.from_seed(bytes([i]) * 4)
+
+
+def _enr(identity: NodeIdentity, udp_port: int = 0, attnets: int = 0) -> ENR:
+    return ENR(
+        node_id=identity.peer_id,
+        pubkey=identity.public_bytes,
+        ip="127.0.0.1",
+        tcp_port=9000,
+        udp_port=udp_port,
+        attnets=attnets,
+    ).sign(identity)
+
+
+def test_enr_sign_verify_roundtrip():
+    ident = _identity(1)
+    enr = _enr(ident, udp_port=1234, attnets=0b1010)
+    assert enr.verify()
+    decoded, _ = ENR.decode(enr.encode())
+    assert decoded.verify()
+    assert decoded.node_id == ident.peer_id
+    assert decoded.udp_port == 1234
+    assert decoded.has_attnet(1) and decoded.has_attnet(3)
+    assert not decoded.has_attnet(0)
+    # tampering breaks the signature
+    tampered = _enr(ident)
+    tampered.attnets = 0xFF
+    assert not tampered.verify()
+
+
+def test_enr_rejects_wrong_identity():
+    enr = _enr(_identity(1))
+    enr.node_id = _identity(2).peer_id  # claim someone else's id
+    assert not enr.verify()
+
+
+def test_routing_table_buckets_and_closest():
+    local = _identity(0)
+    table = RoutingTable(local.peer_id)
+    enrs = [_enr(_identity(i)) for i in range(1, 40)]
+    kept = [enr for enr in enrs if table.update(enr)]
+    # most random ids share the top log2-distance buckets, which cap at
+    # K_BUCKET_SIZE — the table is bounded, not exhaustive
+    assert len(table) == len(kept) <= 39
+    assert len(kept) >= 16
+    target = _identity(99).peer_id
+    closest = table.closest(target, 5)
+    dists = [_distance(target, e.node_id) for e in closest]
+    assert dists == sorted(dists)
+    kept_dists = sorted(_distance(target, e.node_id) for e in kept)
+    assert dists == kept_dists[:5]
+
+
+def test_table_ignores_invalid_and_self():
+    local = _identity(0)
+    table = RoutingTable(local.peer_id)
+    assert not table.update(_enr(local))  # self
+    bad = _enr(_identity(1))
+    bad.signature = b"\x00" * 64
+    assert not table.update(bad)
+
+
+def test_live_ping_and_lookup_converges():
+    async def main():
+        idents = [_identity(10 + i) for i in range(5)]
+        discos = []
+        for ident in idents:
+            d = Discovery(ident, _enr(ident))
+            await d.start()
+            discos.append(d)
+        try:
+            # everyone bootstraps off node 0
+            boot = discos[0].local_enr
+            for d in discos[1:]:
+                await d.bootstrap([boot])
+            # node 0 has learned the others from their pings; lookups spread
+            for d in discos[1:]:
+                await d.lookup(d.local_enr.node_id)
+            # every node should now know every other node
+            for d in discos:
+                known = {e.node_id for e in d.table.all()}
+                expected = {x.local_enr.node_id for x in discos} - {d.local_enr.node_id}
+                assert expected <= known, (
+                    f"{d.local_enr.node_id[:8]} missing {len(expected - known)}"
+                )
+        finally:
+            for d in discos:
+                d.stop()
+
+    run(main())
+
+
+def test_subnet_targeted_query_and_attnets_update():
+    async def main():
+        a, b, c = (_identity(20 + i) for i in range(3))
+        da = Discovery(a, _enr(a))
+        db = Discovery(b, _enr(b, attnets=1 << 7))
+        dc = Discovery(c, _enr(c))
+        for d in (da, db, dc):
+            await d.start()
+        try:
+            await db.bootstrap([da.local_enr])
+            await dc.bootstrap([da.local_enr])
+            await da.lookup(da.local_enr.node_id)
+            peers = da.find_peers_for_subnet(7)
+            assert [e.node_id for e in peers] == [db.local_enr.node_id]
+            # dc starts advertising subnet 7; its re-ping updates da's table
+            bits = [False] * 64
+            bits[7] = True
+            dc.update_attnets(bits)
+            await dc.ping(da.local_enr)
+            peers = {e.node_id for e in da.find_peers_for_subnet(7)}
+            assert dc.local_enr.node_id in peers
+        finally:
+            for d in (da, db, dc):
+                d.stop()
+
+    run(main())
+
+
+def test_discovered_callback_fires():
+    async def main():
+        a, b = _identity(30), _identity(31)
+        da, db = Discovery(a, _enr(a)), Discovery(b, _enr(b))
+        await da.start()
+        await db.start()
+        found = []
+        da.on_discovered.append(lambda enr: found.append(enr.node_id))
+        try:
+            await db.bootstrap([da.local_enr])
+            for _ in range(50):
+                if found:
+                    break
+                await asyncio.sleep(0.02)
+            assert db.local_enr.node_id in found
+        finally:
+            da.stop()
+            db.stop()
+
+    run(main())
+
+
+def test_network_auto_dials_discovered_peers():
+    """Full integration: nodes find each other via discovery and dial
+    automatically — no manual connect() (reference: discv5 → PeerManager)."""
+    from lodestar_tpu.network.network import Network
+
+    from tests.test_network_live import _fresh_chain
+
+    async def main():
+        nets = []
+        for i in range(3):
+            config, types, chain = _fresh_chain()
+            net = Network(
+                config, types, chain,
+                identity=NodeIdentity.from_seed(bytes([40 + i])),
+                verify_signatures=False,
+            )
+            nets.append(net)
+        try:
+            await nets[0].start(discovery=True)
+            boot = [nets[0].discovery.local_enr]
+            for net in nets[1:]:
+                await net.start(discovery=True, bootnodes=boot)
+            # one lookup round spreads the ENRs; network heartbeats retry
+            # dialing anything known-but-unconnected
+            for n in nets:
+                await n.discovery.lookup(n.peer_id)
+            for _ in range(200):
+                if all(len(n.transport.connections) >= 2 for n in nets):
+                    break
+                await asyncio.sleep(0.1)
+            counts = [len(n.transport.connections) for n in nets]
+            assert all(c >= 1 for c in counts), counts
+            # at least the bootstrap hub is fully connected
+            assert len(nets[0].transport.connections) == 2
+        finally:
+            for net in nets:
+                await net.stop()
+
+    run(main())

@@ -18,7 +18,13 @@ def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 120.0))
 
 
+_genesis_cache = {}
+
+
 def _fresh_chain():
+    """A chain on the shared interop genesis — genesis construction does 16
+    real BLS deposit verifications (~seconds each on the CPU oracle), so it
+    is built once per process and copied per node."""
     from lodestar_tpu.chain import BeaconChain
     from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
     from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
@@ -26,13 +32,18 @@ def _fresh_chain():
     from lodestar_tpu.state_transition import interop_genesis_state
     from lodestar_tpu.types import get_types
 
-    types = get_types(MINIMAL).phase0
-    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
-    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
-    config = BeaconConfig(
-        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
-    )
-    return config, types, BeaconChain(config, types, state)
+    if not _genesis_cache:
+        types = get_types(MINIMAL).phase0
+        fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+        state = interop_genesis_state(
+            fork_config, types, 16, genesis_time=1_600_000_000
+        )
+        config = BeaconConfig(
+            MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+        )
+        _genesis_cache["v"] = (config, types, state)
+    config, types, state = _genesis_cache["v"]
+    return config, types, BeaconChain(config, types, state.copy())
 
 
 def _produce_signed_block(config, types, chain, slot):
