@@ -141,3 +141,21 @@ class BufferedVerifier:
             pos += len(sets)
             if not fut.done():
                 fut.set_result(all(share))
+
+
+class MockBlsVerifier:
+    """Constant-result verifier for tests/sims (reference
+    `test/utils/mocks/bls.ts:3` BlsVerifierMock) — exercises every code
+    path around signature verification without paying for pairings."""
+
+    def __init__(self, result: bool = True):
+        self.result = result
+        self.sets_seen = 0
+
+    def verify_signature_sets(self, sets) -> bool:
+        self.sets_seen += len(sets)
+        return self.result
+
+    def verify_signature_sets_individual(self, sets) -> list[bool]:
+        self.sets_seen += len(sets)
+        return [self.result] * len(sets)

@@ -67,12 +67,35 @@ class AggregatedAttestationPool:
         self._epoch_of_root: dict[bytes, int] = {}
 
     def add(self, attestation, data_root: bytes) -> None:
+        """Insert, merging into an existing variant when bit-disjoint
+        (reference aggregateInto: OR the bits, aggregate the signatures) —
+        partial aggregates from different nodes combine into full ones."""
+        from ..bls import api as bls
+
         bits = list(attestation.aggregation_bits)
+        sig = bytes(attestation.signature)
         data, variants = self._by_root.setdefault(
             data_root, (attestation.data.copy(), [])
         )
-        variants.append((bits, bytes(attestation.signature)))
         self._epoch_of_root[data_root] = attestation.data.target.epoch
+        for i, (vbits, vsig) in enumerate(variants):
+            if len(vbits) != len(bits):
+                continue
+            if all(v or not b for v, b in zip(vbits, bits)):
+                return  # non-strict subset of an existing variant: redundant
+            if not any(v and b for v, b in zip(vbits, bits)):
+                merged_sig = bls.aggregate_signatures(
+                    [
+                        bls.Signature.from_bytes(vsig, validate=False),
+                        bls.Signature.from_bytes(sig, validate=False),
+                    ]
+                ).to_bytes()
+                variants[i] = (
+                    [v or b for v, b in zip(vbits, bits)],
+                    merged_sig,
+                )
+                return
+        variants.append((bits, sig))
 
     def get_attestations_for_block(self, types, cached, max_attestations: int):
         """Pick the best variant per data root, preferring recent slots and

@@ -55,7 +55,12 @@ class ENR:
     signature: bytes = b""
 
     def signing_payload(self) -> bytes:
-        ip_raw = bytes(int(x) for x in self.ip.split("."))
+        import socket
+
+        try:
+            ip_raw = socket.inet_pton(socket.AF_INET, self.ip)
+        except OSError:
+            ip_raw = socket.inet_pton(socket.AF_INET6, self.ip)
         return (
             b"enr:"
             + self.pubkey
@@ -92,8 +97,12 @@ class ENR:
             raise ValueError("bad ENR encoding")
         pubkey = payload[4:36]
         seq, tcp_port, udp_port = struct.unpack_from(">QHH", payload, 36)
+        import socket
+
         ip_len = payload[48]
-        ip = ".".join(str(b) for b in payload[49 : 49 + ip_len])
+        ip_raw = payload[49 : 49 + ip_len]
+        family = socket.AF_INET if ip_len == 4 else socket.AF_INET6
+        ip = socket.inet_ntop(family, ip_raw)
         rest = payload[49 + ip_len :]
         fork_digest = rest[:4]
         attnets = int.from_bytes(rest[4 : 4 + ATTESTATION_SUBNET_COUNT // 8], "little")
@@ -136,6 +145,8 @@ class RoutingTable:
         return self.buckets[d.bit_length() - 1 if d else 0]
 
     def update(self, enr: ENR) -> bool:
+        """Insert/refresh; True only when the node is NEW to the table (the
+        discovered-callback trigger — refreshes are not discoveries)."""
         if enr.node_id == self.local_id or not enr.verify():
             return False
         bucket = self._bucket_of(enr.node_id)
@@ -143,7 +154,7 @@ class RoutingTable:
         if entry is not None:
             if enr.seq >= entry.enr.seq:
                 bucket[enr.node_id] = _BucketEntry(enr)
-            return True
+            return False
         if len(bucket) >= K_BUCKET_SIZE:
             # evict stalest entry (liveness-checked eviction is the ping
             # loop's job; here we keep the table bounded)
@@ -186,6 +197,9 @@ class Discovery(asyncio.DatagramProtocol):
         self._pending_pong: dict[str, asyncio.Future] = {}
         self._pending_nodes: dict[str, asyncio.Future] = {}
         self._known_keys: dict[str, bytes] = {}  # node_id → pubkey
+        self._last_nonce: dict[str, int] = {}  # node_id → highest seen nonce
+        self._nonce = int(time.time() * 1000) << 16  # survives restarts
+        self._liveness_task: asyncio.Task | None = None
         self.on_discovered: list = []  # callbacks(enr)
 
     # -- lifecycle -----------------------------------------------------------
@@ -202,7 +216,32 @@ class Discovery(asyncio.DatagramProtocol):
             self.local_enr.sign(self.identity)
         return addr
 
+    def start_liveness_loop(self) -> None:
+        """Periodically ping the stalest table entries; dead ones are
+        evicted by ping()'s timeout path (discv5 liveness checks)."""
+        self._liveness_task = asyncio.get_running_loop().create_task(
+            self._liveness_loop()
+        )
+
+    async def _liveness_loop(self) -> None:
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            now = time.monotonic()
+            stale = sorted(
+                (
+                    e
+                    for b in self.table.buckets
+                    for e in b.values()
+                    if now - e.last_seen > PING_INTERVAL
+                ),
+                key=lambda e: e.last_seen,
+            )[:4]
+            for entry in stale:
+                await self.ping(entry.enr)
+
     def stop(self) -> None:
+        if self._liveness_task is not None:
+            self._liveness_task.cancel()
         if self.transport_udp is not None:
             self.transport_udp.close()
 
@@ -211,7 +250,11 @@ class Discovery(asyncio.DatagramProtocol):
     def _send(self, addr, ptype: int, body: bytes) -> None:
         if self.transport_udp is None:
             return
-        content = bytes([ptype]) + body
+        # monotonic per-sender nonce, covered by the signature: receivers
+        # reject non-increasing nonces, so captured packets can't be
+        # replayed to fake liveness or reflect NODES at victims
+        self._nonce += 1
+        content = struct.pack(">Q", self._nonce) + bytes([ptype]) + body
         sig = self.identity.sign(b"disc:" + content)
         packet = self.local_enr.node_id.encode() + sig + content
         if len(packet) <= MAX_PACKET:
@@ -221,23 +264,29 @@ class Discovery(asyncio.DatagramProtocol):
         try:
             node_id = data[:40].decode()
             sig, content = data[40:104], data[104:]
-            ptype, body = content[0], content[1:]
+            (nonce,) = struct.unpack_from(">Q", content, 0)
+            ptype, body = content[8], content[9:]
         except Exception:
             return
+        if nonce <= self._last_nonce.get(node_id, 0):
+            return  # replayed or reordered-stale packet
         asyncio.get_running_loop().create_task(
-            self._handle(node_id, sig, ptype, body, addr)
+            self._handle(node_id, sig, nonce, ptype, body, addr, content)
         )
 
-    async def _handle(self, node_id: str, sig: bytes, ptype: int, body: bytes, addr):
-        # Authentication: PING/NODES carry the sender's ENR (with pubkey);
+    async def _handle(
+        self, node_id: str, sig: bytes, nonce: int, ptype: int, body: bytes, addr, content: bytes
+    ):
+        # Authentication: PING carries the sender's ENR (with pubkey);
         # other packets must come from a node whose key we've learned.
         try:
             if ptype == _PING:
                 enr, _ = ENR.decode(body)
                 if enr.node_id != node_id or not enr.verify():
                     return
-                if not verify_identity(enr.pubkey, sig, b"disc:" + bytes([ptype]) + body):
+                if not verify_identity(enr.pubkey, sig, b"disc:" + content):
                     return
+                self._last_nonce[node_id] = nonce
                 self._known_keys[node_id] = enr.pubkey
                 if self.table.update(enr):
                     self._notify(enr)
@@ -247,9 +296,10 @@ class Discovery(asyncio.DatagramProtocol):
 
             pubkey = self._pubkey_for(node_id)
             if pubkey is None or not verify_identity(
-                pubkey, sig, b"disc:" + bytes([ptype]) + body
+                pubkey, sig, b"disc:" + content
             ):
                 return
+            self._last_nonce[node_id] = nonce
             self.table.touch(node_id)
 
             if ptype == _PONG:
@@ -322,6 +372,10 @@ class Discovery(asyncio.DatagramProtocol):
         except asyncio.TimeoutError:
             self.table.remove(enr.node_id)
             return False
+        finally:
+            # a stale future must not swallow a later request's response
+            if self._pending_pong.get(enr.node_id) is fut:
+                del self._pending_pong[enr.node_id]
 
     async def find_node(self, enr: ENR, target_id: str, timeout: float = 2.0) -> list[ENR]:
         fut = asyncio.get_running_loop().create_future()
@@ -331,11 +385,16 @@ class Discovery(asyncio.DatagramProtocol):
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             return []
+        finally:
+            if self._pending_nodes.get(enr.node_id) is fut:
+                del self._pending_nodes[enr.node_id]
 
     async def bootstrap(self, bootnodes: list[ENR]) -> None:
         for enr in bootnodes:
+            if not enr.verify() or enr.node_id == self.local_enr.node_id:
+                continue
+            self._known_keys[enr.node_id] = enr.pubkey
             if self.table.update(enr):
-                self._known_keys[enr.node_id] = enr.pubkey
                 self._notify(enr)
             await self.ping(enr)
         await self.lookup(self.local_enr.node_id)

@@ -495,7 +495,14 @@ class GossipsubService:
                 await stream.write(len(data).to_bytes(4, "big") + data)
 
         self.router.add_peer(conn.peer_id, send, outbound=conn.initiator)
-        conn.on_close.append(lambda: self.router.remove_peer(conn.peer_id))
+
+        # only drop the router peer if this conn is still the live one —
+        # an _adopt-replaced conn closing must not evict its successor
+        def on_close(c=conn):
+            if self.transport.connections.get(c.peer_id) is None:
+                self.router.remove_peer(c.peer_id)
+
+        conn.on_close.append(on_close)
         # announce current subscriptions to the new peer
         subs = [(True, t) for t in self.router.subscriptions]
         if subs:

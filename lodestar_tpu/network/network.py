@@ -77,6 +77,7 @@ class Network:
         self.attnets = AttnetsService(node_id, config.preset.SLOTS_PER_EPOCH)
 
         self.discovery = None  # enabled via start(discovery=True)
+        self._dial_backoff: dict[str, float] = {}  # node_id → retry-after
 
         self._heartbeat_task: asyncio.Task | None = None
         self.transport.on_connection.append(self._on_connection)
@@ -126,6 +127,7 @@ class Network:
         self.discovery.update_attnets(attnets)
         self.discovery.on_discovered.append(self._on_discovered)
         await self.discovery.start(bind_host or advertise_addr[0])
+        self.discovery.start_liveness_loop()
         if bootnodes:
             await self.discovery.bootstrap(bootnodes)
 
@@ -139,9 +141,29 @@ class Network:
             return
         asyncio.get_running_loop().create_task(self._dial_enr(enr))
 
+    def _may_dial(self, node_id: str, now: float) -> bool:
+        from .peers import ScoreState as _SS
+
+        if self.peer_manager.scores.state(node_id) == _SS.Banned:
+            return False
+        return self._dial_backoff.get(node_id, 0.0) <= now
+
     async def _dial_enr(self, enr) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if not self._may_dial(enr.node_id, now):
+            return
+        # exponential per-peer backoff so dead records don't get a fresh
+        # connect attempt every heartbeat
+        prev = self._dial_backoff.get(enr.node_id)
+        delay = DIAL_TIMEOUT if prev is None else min(
+            300.0, max(DIAL_TIMEOUT, (prev - now) * 2 if prev > now else DIAL_TIMEOUT * 2)
+        )
+        self._dial_backoff[enr.node_id] = now + delay
         try:
             await asyncio.wait_for(self.connect(enr.ip, enr.tcp_port), DIAL_TIMEOUT)
+            self._dial_backoff.pop(enr.node_id, None)
         except Exception as e:
             log.debug(f"dial {enr.node_id[:8]} failed: {e}")
 
@@ -242,7 +264,13 @@ class Network:
         ):
             asyncio.get_running_loop().create_task(conn.close())
             return
-        conn.on_close.append(lambda: self.peer_manager.on_disconnect(conn.peer_id))
+        # a replaced connection (simultaneous cross-dial) must not tear down
+        # the live successor's PeerInfo — only the CURRENT conn's close counts
+        def on_close(c=conn):
+            if self.transport.connections.get(c.peer_id) is None:
+                self.peer_manager.on_disconnect(c.peer_id)
+
+        conn.on_close.append(on_close)
         asyncio.get_running_loop().create_task(self._status_handshake(conn.peer_id))
 
     async def _status_handshake(self, peer_id: str) -> None:
@@ -272,10 +300,14 @@ class Network:
                         self.transport.connections
                     )
                     if want > 0:
+                        import time as _time
+
+                        now = _time.monotonic()
                         candidates = [
                             enr
                             for enr in self.discovery.table.all()
                             if enr.node_id not in self.transport.connections
+                            and self._may_dial(enr.node_id, now)
                         ][:want]
                         for enr in candidates:
                             asyncio.get_running_loop().create_task(

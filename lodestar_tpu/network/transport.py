@@ -541,8 +541,17 @@ class Transport:
         return self._adopt(Connection(self, reader, writer, channel, peer_id, pub, True))
 
     def _adopt(self, conn: Connection) -> Connection:
-        old = self.connections.pop(conn.peer_id, None)
-        if old is not None:
+        old = self.connections.get(conn.peer_id)
+        if old is not None and not old._closed:
+            # simultaneous open: both sides dialed each other, and each
+            # would otherwise keep the TCP stream the other discarded.
+            # Deterministic tiebreak — BOTH ends keep the connection whose
+            # initiator has the smaller peer id — picks one shared stream.
+            if old.initiator != conn.initiator:
+                keep_old = old.initiator == (self.peer_id < conn.peer_id)
+                if keep_old:
+                    asyncio.get_running_loop().create_task(conn.close())
+                    return old
             asyncio.get_running_loop().create_task(old.close())
         self.connections[conn.peer_id] = conn
         conn._start()

@@ -163,3 +163,37 @@ class ValidatorService:
             self.chain.on_aggregated_attestation(att, data.hash_tree_root())
             produced.append(att)
         return produced
+
+    def aggregate_if_due(self, slot: int, attestations: list) -> list:
+        """Build SignedAggregateAndProof for every duty where one of our
+        validators is the selected aggregator of its committee (reference
+        AttestationService aggregation phase at 2·slot/3)."""
+        state = self.chain.head_state
+        ctx = state.epoch_ctx
+        epoch = slot // self.config.preset.SLOTS_PER_EPOCH
+        indices = self._validator_indices()
+        by_committee = {
+            (int(a.data.slot), int(a.data.index)): a for a in attestations
+        }
+        out = []
+        for cidx in range(ctx.get_committee_count_per_slot(epoch)):
+            committee = [int(v) for v in ctx.get_beacon_committee(slot, cidx)]
+            ours = [(pk, idx) for pk, idx in indices.items() if idx in committee]
+            for pk, idx in ours:
+                # the selection proof doubles as the aggregator lottery
+                # ticket — sign once, reuse for the check and the envelope
+                proof = self.store.sign_selection_proof(pk, slot)
+                if not self.store.is_aggregator(slot, len(committee), pk, proof=proof):
+                    continue
+                agg = by_committee.get((slot, cidx))
+                if agg is None:
+                    continue
+                agg_and_proof = self.types.AggregateAndProof(
+                    aggregator_index=idx,
+                    aggregate=agg.copy(),
+                    selection_proof=proof,
+                )
+                out.append(
+                    self.store.sign_aggregate_and_proof(pk, self.types, agg_and_proof)
+                )
+        return out

@@ -110,12 +110,15 @@ class ValidatorStore:
             message=agg_and_proof, signature=sig
         )
 
-    def is_aggregator(self, slot: int, committee_size: int, pubkey: bytes) -> bool:
+    def is_aggregator(
+        self, slot: int, committee_size: int, pubkey: bytes, proof: bytes | None = None
+    ) -> bool:
         """TARGET_AGGREGATORS_PER_COMMITTEE-based selection (spec
         is_aggregator): hash(selection_proof) mod max(1, size/16) == 0."""
         from ..params import TARGET_AGGREGATORS_PER_COMMITTEE
         from ..ssz.hashing import sha256
 
-        proof = self.sign_selection_proof(pubkey, slot)
+        if proof is None:
+            proof = self.sign_selection_proof(pubkey, slot)
         modulo = max(1, committee_size // TARGET_AGGREGATORS_PER_COMMITTEE)
         return int.from_bytes(sha256(proof)[:8], "little") % modulo == 0
