@@ -171,10 +171,59 @@ class BeaconApiImpl:
         self.chain.op_pool.add_voluntary_exit(exit_)
         return None
 
+    def submitPoolProposerSlashings(self, params, query, body):
+        slashing = self.types.ProposerSlashing.from_obj(body)
+        self.chain.op_pool.add_proposer_slashing(slashing)
+        return None
+
+    def submitPoolAttesterSlashings(self, params, query, body):
+        slashing = self.types.AttesterSlashing.from_obj(body)
+        self.chain.op_pool.add_attester_slashing(slashing)
+        return None
+
+    def getPoolProposerSlashings(self, params, query, body):
+        return [s.to_obj() for s in self.chain.op_pool.proposer_slashings.values()]
+
+    def getPoolAttesterSlashings(self, params, query, body):
+        return [s.to_obj() for s in self.chain.op_pool.attester_slashings]
+
     # -- node ----------------------------------------------------------------
 
     def getNodeVersion(self, params, query, body):
         return {"version": self.VERSION}
+
+    def getNodeIdentity(self, params, query, body):
+        """Peer id + shareable ENR of the attached network (routes/node.ts
+        getNetworkIdentity)."""
+        network = getattr(self, "network", None)
+        if network is None:
+            return {"peer_id": "", "enr": "", "p2p_addresses": []}
+        enr_text = ""
+        if network.discovery is not None:
+            from ..network.discovery import enr_to_text
+
+            enr_text = enr_to_text(network.discovery.local_enr)
+        addr = network.transport.listen_addr
+        return {
+            "peer_id": network.peer_id,
+            "enr": enr_text,
+            "p2p_addresses": [f"{addr[0]}:{addr[1]}"] if addr else [],
+        }
+
+    def getNodePeers(self, params, query, body):
+        network = getattr(self, "network", None)
+        if network is None:
+            return []
+        out = []
+        for pid, info in network.peer_manager.peers.items():
+            out.append(
+                {
+                    "peer_id": pid,
+                    "state": "connected" if pid in network.transport.connections else "disconnected",
+                    "direction": info.direction,
+                }
+            )
+        return out
 
     def getSyncingStatus(self, params, query, body):
         head_slot = self.chain.head_state.state.slot

@@ -32,8 +32,14 @@ API_ROUTES: list[Route] = [
     Route("publishBlock", "POST", "/eth/v1/beacon/blocks"),
     Route("submitPoolAttestations", "POST", "/eth/v1/beacon/pool/attestations"),
     Route("submitPoolVoluntaryExit", "POST", "/eth/v1/beacon/pool/voluntary_exits"),
+    Route("submitPoolProposerSlashings", "POST", "/eth/v1/beacon/pool/proposer_slashings"),
+    Route("submitPoolAttesterSlashings", "POST", "/eth/v1/beacon/pool/attester_slashings"),
+    Route("getPoolProposerSlashings", "GET", "/eth/v1/beacon/pool/proposer_slashings"),
+    Route("getPoolAttesterSlashings", "GET", "/eth/v1/beacon/pool/attester_slashings"),
     # node (routes/node.ts)
     Route("getNodeVersion", "GET", "/eth/v1/node/version"),
+    Route("getNodeIdentity", "GET", "/eth/v1/node/identity"),
+    Route("getNodePeers", "GET", "/eth/v1/node/peers"),
     Route("getSyncingStatus", "GET", "/eth/v1/node/syncing"),
     Route("getHealth", "GET", "/eth/v1/node/health"),
     # config (routes/config.ts)

@@ -12,6 +12,8 @@ verification over all sets of the segment (through the pluggable verifier
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..bls import api as bls
@@ -66,6 +68,9 @@ class BeaconChain:
         self.preset = config.preset
         self.bls = verifier if verifier is not None else CpuBlsVerifier()
         self.execution_engine = execution_engine
+        # serializes chain mutation between the event loop (gossip) and
+        # worker threads (range sync, REST) — see process_block
+        self.import_lock = threading.RLock()
 
         cached = CachedBeaconState(config, anchor_state, self.preset)
         self.head_state = cached
@@ -142,6 +147,13 @@ class BeaconChain:
     # -- block import (reference chain/blocks pipeline) ----------------------
 
     def process_block(self, signed_block, verify_signatures: bool = True):
+        # one writer at a time: gossip handlers run on the event loop while
+        # range sync imports from an executor thread — the import lock keeps
+        # the state-transition + fork-choice update atomic per block
+        with self.import_lock:
+            return self._process_block_locked(signed_block, verify_signatures)
+
+    def _process_block_locked(self, signed_block, verify_signatures: bool = True):
         block = signed_block.message
         block_root = block.hash_tree_root()
         # sanity checks (verifyBlocksSanityChecks)
@@ -300,9 +312,14 @@ class BeaconChain:
     # -- attestation intake (gossip path) ------------------------------------
 
     def on_gossip_attestation(self, attestation, data_root: bytes) -> None:
-        self.attestation_pool.add(attestation, data_root)
+        with self.import_lock:
+            self.attestation_pool.add(attestation, data_root)
 
     def on_aggregated_attestation(self, attestation, data_root: bytes) -> None:
+        with self.import_lock:
+            self._on_aggregated_attestation_locked(attestation, data_root)
+
+    def _on_aggregated_attestation_locked(self, attestation, data_root: bytes) -> None:
         self.aggregated_pool.add(attestation, data_root)
         try:
             state = self.head_state

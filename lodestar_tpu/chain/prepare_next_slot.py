@@ -27,6 +27,10 @@ class PrepareNextSlotScheduler:
         head = chain.head_state
         if head.state.slot >= next_slot:
             return
+        # far behind the clock (pre-sync): preparing the next slot would
+        # replay the whole gap through process_slots — skip until caught up
+        if next_slot - head.state.slot > 2 * chain.preset.SLOTS_PER_EPOCH:
+            return
         try:
             pre = head.copy()
             process_slots(pre, chain.types, next_slot)

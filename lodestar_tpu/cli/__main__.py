@@ -8,6 +8,7 @@ import sys
 
 from .beacon import add_beacon_parser
 from .dev import add_dev_parser
+from .flare import add_flare_parser
 from .lightclient import add_lightclient_parser
 from .validator import add_validator_parser
 
@@ -21,6 +22,7 @@ def main(argv=None) -> int:
     add_beacon_parser(sub)
     add_validator_parser(sub)
     add_lightclient_parser(sub)
+    add_flare_parser(sub)
     args = parser.parse_args(argv)
     return args.func(args)
 

@@ -53,7 +53,18 @@ def run_beacon(args) -> int:
         checkpoint_fork, checkpoint_bytes = _fetch_checkpoint_state(
             args.checkpoint_sync_url
         )
-    db_controller = FileDb(args.datadir) if args.datadir else MemoryDb()
+    if args.datadir:
+        import os
+
+        if os.path.isfile(args.datadir):
+            # legacy layout: --datadir pointed straight at the db log file
+            log.info("using legacy single-file datadir layout")
+            db_controller = FileDb(args.datadir)
+        else:
+            os.makedirs(args.datadir, exist_ok=True)
+            db_controller = FileDb(os.path.join(args.datadir, "chain.db"))
+    else:
+        db_controller = MemoryDb()
     probe_db = BeaconDb(types_all.phase0, db_controller)
     if checkpoint_bytes is None and args.genesis_validators:
         genesis_state = interop_genesis_state(
@@ -110,24 +121,154 @@ def run_beacon(args) -> int:
 
     signal.signal(signal.SIGINT, _sigint)
 
-    genesis_time = state.genesis_time
-    spt = config.SECONDS_PER_SLOT
+    if args.port:
+        return _run_networked(args, node, config, types, stop, log)
+
+    clock = _SlotClock(node, state.genesis_time, config.SECONDS_PER_SLOT, args.run_seconds)
     try:
-        last_slot = -1
-        deadline = time.time() + args.run_seconds if args.run_seconds else None
-        while not stop["flag"]:
-            now = time.time()
-            if deadline and now >= deadline:
-                break
-            slot = max(0, int(now - genesis_time) // spt)
-            if slot != last_slot:
-                node.on_clock_slot(slot)
-                last_slot = slot
-            time.sleep(min(0.2, spt / 10))
+        while not stop["flag"] and not clock.expired():
+            clock.tick()
+            time.sleep(clock.nap())
         return 0
     finally:
         node.close()
         log.info("node stopped; state persisted")
+
+
+class _SlotClock:
+    """Wall-clock slot follower shared by the plain and networked loops."""
+
+    def __init__(self, node, genesis_time: int, seconds_per_slot: int, run_seconds: float):
+        self.node = node
+        self.genesis_time = genesis_time
+        self.spt = seconds_per_slot
+        self.deadline = time.time() + run_seconds if run_seconds else None
+        self.last_slot = -1
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.time() >= self.deadline
+
+    def current_slot(self) -> int:
+        return max(0, int(time.time() - self.genesis_time) // self.spt)
+
+    def tick(self) -> int | None:
+        """Advance the node if a new slot started; returns it (else None)."""
+        slot = self.current_slot()
+        if slot == self.last_slot:
+            return None
+        self.node.on_clock_slot(slot)
+        self.last_slot = slot
+        return slot
+
+    def nap(self) -> float:
+        return min(0.2, self.spt / 10)
+
+
+def _run_networked(args, node, config, types, stop, log) -> int:
+    """Live-networked node: gossip + discovery + reqresp + range sync
+    (reference beacon handler with network.start, §3.1)."""
+    import asyncio
+    import os
+
+    from ..network.discovery import enr_from_text, enr_to_text
+    from ..network.network import Network
+
+    async def main() -> int:
+        bootnodes = []
+        for text in (args.bootnodes or "").split(","):
+            text = text.strip()
+            if text:
+                bootnodes.append(enr_from_text(text))
+        network = Network(
+            config, types, node.chain, identity=_load_identity(args.datadir)
+        )
+        await network.start(
+            host=args.listen_address,
+            port=args.port if args.port > 0 else 0,
+            discovery=True,
+            bootnodes=bootnodes,
+            advertise_ip=args.advertise_ip,
+        )
+        node.attach_network(network)
+        enr_text = enr_to_text(network.discovery.local_enr)
+        log.info("p2p listening on %s, peer id %s", network.transport.listen_addr, network.peer_id[:16])
+        log.info("ENR: %s", enr_text)
+        if args.datadir and os.path.isdir(args.datadir):
+            with open(os.path.join(args.datadir, "enr.txt"), "w") as f:
+                f.write(enr_text + "\n")
+
+        clock = _SlotClock(
+            node, node.chain.head_state.state.genesis_time,
+            config.SECONDS_PER_SLOT, args.run_seconds,
+        )
+        loop = asyncio.get_running_loop()
+        try:
+            while not stop["flag"] and not clock.expired():
+                slot = clock.tick()
+                if slot is not None:
+                    await _maybe_range_sync(node, network, slot, loop, log)
+                await asyncio.sleep(clock.nap())
+            return 0
+        finally:
+            await network.stop()
+            node.close()
+            log.info("node stopped; state persisted")
+
+    return asyncio.run(main())
+
+
+def _load_identity(datadir):
+    """Persist the p2p identity key under the datadir so the node's peer id
+    and ENR survive restarts (reference: ENR + peer-id persistence)."""
+    from ..network.transport import NodeIdentity
+
+    if not datadir:
+        return None
+    import os
+
+    if os.path.isfile(datadir):
+        return None  # legacy single-file layout has nowhere to keep it
+    path = os.path.join(datadir, "network_key")
+    if os.path.exists(path):
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        with open(path, "rb") as f:
+            return NodeIdentity(Ed25519PrivateKey.from_private_bytes(f.read()))
+    identity = NodeIdentity()
+    raw = identity.private_key.private_bytes_raw()
+    with open(path, "wb") as f:
+        f.write(raw)
+    os.chmod(path, 0o600)
+    return identity
+
+
+async def _maybe_range_sync(node, network, clock_slot: int, loop, log) -> None:
+    """If the head trails the clock by more than an epoch, range-sync from
+    the best-status peer (reference RangeSync trigger)."""
+    from ..sync.range_sync import RangeSync
+
+    head_slot = node.chain.head_state.state.slot
+    if clock_slot <= head_slot + node.config.preset.SLOTS_PER_EPOCH:
+        return
+    peers = network.sync_peers(loop)
+    if not peers:
+        return
+
+    def run_sync() -> int:
+        rs = RangeSync(
+            node.chain, node.types, node.config.preset.SLOTS_PER_EPOCH
+        )
+        for peer in peers:
+            rs.add_peer(peer)
+        return rs.sync_to(clock_slot)
+
+    try:
+        synced = await loop.run_in_executor(None, run_sync)
+        log.info("range sync reached slot %d", synced)
+    except Exception as e:
+        log.warning("range sync failed: %s", e)
 
 
 def add_beacon_parser(sub) -> None:
@@ -145,4 +286,8 @@ def add_beacon_parser(sub) -> None:
     p.add_argument("--jwt-secret", default=None, help="hex engine-API JWT secret")
     p.add_argument("--tpu-verifier", action="store_true")
     p.add_argument("--run-seconds", type=float, default=0, help="exit after N seconds (0 = forever)")
+    p.add_argument("--port", type=int, default=0, help="p2p TCP/UDP listen port (enables live networking; -1 = ephemeral)")
+    p.add_argument("--bootnodes", default=None, help="comma-separated enr-tpu: records to bootstrap from")
+    p.add_argument("--advertise-ip", default=None, help="external address advertised in the ENR")
+    p.add_argument("--listen-address", default="127.0.0.1", help="p2p bind address (use 0.0.0.0 with --advertise-ip for WAN)")
     p.set_defaults(func=run_beacon)

@@ -61,6 +61,13 @@ class ForkChoice:
     # -- time ----------------------------------------------------------------
 
     def update_time(self, current_slot: int) -> None:
+        if current_slot - self.store.current_slot > 2 * self.slots_per_epoch:
+            # far-future jump (node way behind wall clock): stepping every
+            # slot would grind millions of iterations — land directly and
+            # drain the attestation queue once
+            self.store.current_slot = current_slot
+            self._process_queued_attestations()
+            return
         while self.store.current_slot < current_slot:
             self.store.current_slot += 1
             if self.store.current_slot % self.slots_per_epoch == 0:

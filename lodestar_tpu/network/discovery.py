@@ -442,3 +442,23 @@ class Discovery(asyncio.DatagramProtocol):
             self.local_enr.attnets = value
             self.local_enr.seq += 1
             self.local_enr.sign(self.identity)
+
+
+def enr_to_text(enr: ENR) -> str:
+    """Shareable one-line record (role of the base64 `enr:` text form)."""
+    import base64
+
+    return "enr-tpu:" + base64.urlsafe_b64encode(enr.encode()).decode().rstrip("=")
+
+
+def enr_from_text(text: str) -> ENR:
+    import base64
+
+    if not text.startswith("enr-tpu:"):
+        raise ValueError("not an enr-tpu record")
+    raw = text[len("enr-tpu:"):]
+    raw += "=" * (-len(raw) % 4)
+    enr, _ = ENR.decode(base64.urlsafe_b64decode(raw))
+    if not enr.verify():
+        raise ValueError("invalid record signature")
+    return enr

@@ -83,6 +83,7 @@ class BeaconNode:
         # exists (dev mode runs networkless, like reference dev w/o peers)
         self.peers = []
         self.sync = None
+        self.network = None
 
         # 5. servers
         self.api_server = None
@@ -101,6 +102,13 @@ class BeaconNode:
 
         self.notifier = NodeNotifier(self, opts.notifier_interval_slots)
         return self
+
+    def attach_network(self, network) -> None:
+        """Bind a started Network: REST node-identity/peers routes and the
+        sync layer see it (reference nodejs.ts wiring order §3.1)."""
+        self.network = network
+        if self.api_server is not None:
+            self.api_server.impl.network = network
 
     # -- slot driving --------------------------------------------------------
 

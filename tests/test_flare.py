@@ -1,0 +1,93 @@
+"""Flare debug CLI: self-slashing submission against a live node.
+
+Reference analog: `packages/flare` `self-slash-proposer` /
+`self-slash-attester` commands submitting crafted slashings over the
+Beacon API.
+"""
+
+import pytest
+
+from lodestar_tpu.cli.__main__ import main as cli_main
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.node.node import BeaconNode, NodeOptions
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.types import get_types
+
+
+@pytest.fixture(scope="module")
+def node_env():
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    node = BeaconNode.init(
+        config, types, state.copy(), NodeOptions(rest=True, rest_port=0)
+    )
+    yield config, types, node
+    node.close()
+
+
+def test_flare_self_slash_proposer(node_env):
+    config, types, node = node_env
+    rc = cli_main(
+        [
+            "flare", "self-slash-proposer",
+            "--server", f"127.0.0.1:{node.api_server.port}",
+            "--validators", "0..2",
+            "--slot", "1",
+        ]
+    )
+    assert rc == 0
+    pool = node.chain.op_pool
+    assert set(pool.proposer_slashings) >= {0, 1}
+    # the two headers are genuinely conflicting: same slot, different roots
+    slashing = pool.proposer_slashings[0]
+    h1, h2 = slashing.signed_header_1.message, slashing.signed_header_2.message
+    assert int(h1.slot) == int(h2.slot) == 1
+    assert h1.hash_tree_root() != h2.hash_tree_root()
+
+
+def test_flare_self_slash_attester(node_env):
+    config, types, node = node_env
+    rc = cli_main(
+        [
+            "flare", "self-slash-attester",
+            "--server", f"127.0.0.1:{node.api_server.port}",
+            "--validators", "2,3,4",
+            "--slot", "1",
+            "--batch-size", "2",
+        ]
+    )
+    assert rc == 0
+    pool = node.chain.op_pool
+    assert len(pool.attester_slashings) == 2  # batches of 2 then 1
+    from lodestar_tpu.state_transition.block import is_slashable_attestation_data
+
+    for slashing in pool.attester_slashings:
+        assert is_slashable_attestation_data(
+            slashing.attestation_1.data, slashing.attestation_2.data
+        )
+    covered = {
+        int(i)
+        for s in pool.attester_slashings
+        for i in s.attestation_1.attesting_indices
+    }
+    assert covered == {2, 3, 4}
+
+
+def test_flare_pool_routes_roundtrip(node_env):
+    """The GET pool routes serve what flare submitted."""
+    from lodestar_tpu.api.client import BeaconApiClient
+
+    config, types, node = node_env
+    client = BeaconApiClient("127.0.0.1", node.api_server.port)
+    props = client.getPoolProposerSlashings()
+    attrs = client.getPoolAttesterSlashings()
+    assert len(props) >= 2
+    assert len(attrs) == 2
+    restored = types.ProposerSlashing.from_obj(props[0])
+    assert int(restored.signed_header_1.message.slot) == 1

@@ -1,0 +1,112 @@
+"""Spec-test harness: fixture generation + directory runner round-trip.
+
+Reference analog: spec-test-util's describeDirectorySpecTest consuming
+the official layout (`beacon-node/test/spec/presets/*`). The generator
+writes the same nesting; the runner must pass on valid cases, detect
+tampered vectors, and honour expected-invalid (no `post`) semantics.
+"""
+
+import os
+
+import pytest
+
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.spec_test import (
+    run_epoch_processing_suite,
+    run_operations_suite,
+    run_sanity_blocks_suite,
+    run_sanity_slots_suite,
+    run_shuffling_suite,
+)
+from lodestar_tpu.spec_test.fixtures import generate_suite_tree
+from lodestar_tpu.types import get_types
+
+
+@pytest.fixture(scope="module")
+def tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("spec-tests")
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    paths = generate_suite_tree(str(root), fork_config, types, n_validators=16)
+    # config with the generated genesis root for signed-object suites
+    from lodestar_tpu.state_transition import interop_genesis_state
+
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    return paths, config, types
+
+
+def test_sanity_blocks_suite_passes(tree):
+    paths, config, types = tree
+    result = run_sanity_blocks_suite(
+        paths["sanity/blocks"], config, types, verify_signatures=False
+    )
+    assert result.ok(), result.failures
+    assert result.total == 2  # valid 2-block case + expected-invalid case
+
+
+def test_sanity_slots_suite_passes(tree):
+    paths, config, types = tree
+    result = run_sanity_slots_suite(paths["sanity/slots"], config, types)
+    assert result.ok(), result.failures
+    assert result.total == 2
+
+
+def test_operations_suite_expected_invalid(tree):
+    paths, config, types = tree
+    result = run_operations_suite(
+        paths["operations/voluntary_exit"], config, types, "voluntary_exit"
+    )
+    assert result.ok(), result.failures
+
+
+def test_epoch_processing_suite_passes(tree):
+    paths, config, types = tree
+    result = run_epoch_processing_suite(
+        paths["epoch_processing/justification_and_finalization"],
+        config,
+        types,
+        "justification_and_finalization",
+    )
+    assert result.ok(), result.failures
+
+
+def test_shuffling_suite_passes(tree):
+    paths, config, types = tree
+    result = run_shuffling_suite(paths["shuffling"], config)
+    assert result.ok(), result.failures
+    assert result.total == 3
+
+
+def test_tampered_vector_detected(tree):
+    """Corrupting a pinned post state must fail the case — the regression-
+    pinning property the generated vectors exist for."""
+    from lodestar_tpu import native
+
+    paths, config, types = tree
+    suite = paths["sanity/slots"]
+    case_dir = os.path.join(suite, "slots_1")
+    post_path = os.path.join(case_dir, "post.ssz_snappy")
+    original = open(post_path, "rb").read()
+    try:
+        raw = bytearray(native.snappy_uncompress(original))
+        raw[100] ^= 0xFF
+        with open(post_path, "wb") as f:
+            f.write(native.snappy_compress(bytes(raw)))
+        result = run_sanity_slots_suite(suite, config, types)
+        assert not result.ok()
+        assert any("slots_1" in name for name, _ in result.failures)
+    finally:
+        with open(post_path, "wb") as f:
+            f.write(original)
+
+
+def test_runner_reports_totals(tree):
+    paths, config, types = tree
+    result = run_shuffling_suite(paths["shuffling"], config)
+    assert result.total == result.passed == 3
+    assert result.failures == []
