@@ -182,10 +182,10 @@ class BeaconApiImpl:
         return None
 
     def getPoolProposerSlashings(self, params, query, body):
-        return [s.to_obj() for s in self.chain.op_pool.proposer_slashings.values()]
+        return [s.to_obj() for s in list(self.chain.op_pool.proposer_slashings.values())]
 
     def getPoolAttesterSlashings(self, params, query, body):
-        return [s.to_obj() for s in self.chain.op_pool.attester_slashings]
+        return [s.to_obj() for s in list(self.chain.op_pool.attester_slashings)]
 
     # -- node ----------------------------------------------------------------
 

@@ -202,11 +202,18 @@ def _run_networked(args, node, config, types, stop, log) -> int:
             config.SECONDS_PER_SLOT, args.run_seconds,
         )
         loop = asyncio.get_running_loop()
+        sync_state = {"task": None}
         try:
             while not stop["flag"] and not clock.expired():
                 slot = clock.tick()
-                if slot is not None:
-                    await _maybe_range_sync(node, network, slot, loop, log)
+                if slot is not None and (
+                    sync_state["task"] is None or sync_state["task"].done()
+                ):
+                    # background task: the clock must keep ticking and SIGINT
+                    # must stay responsive while a long catch-up sync runs
+                    sync_state["task"] = loop.create_task(
+                        _maybe_range_sync(node, network, slot, loop, log)
+                    )
                 await asyncio.sleep(clock.nap())
             return 0
         finally:

@@ -87,6 +87,8 @@ class GossipHandlers:
     # -- queue processor -----------------------------------------------------
 
     async def _process(self, item) -> ValidationResult:
+        import asyncio
+
         topic, wire = item
         try:
             ssz = decode_message(wire)
@@ -95,7 +97,12 @@ class GossipHandlers:
         from ...ssz import DeserializationError
 
         try:
-            return self._handle(topic, ssz)
+            # run validation + import in an executor thread: the handler does
+            # BLS verification and may wait on the chain's import lock (held
+            # by range sync), neither of which may stall the event loop
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._handle, topic, ssz
+            )
         except DeserializationError:
             return ValidationResult.REJECT  # undecodable object = bad peer
         except Exception as e:  # noqa: BLE001 — a handler bug must not REJECT

@@ -73,8 +73,11 @@ class Network:
         )
 
         # subnets
+        from .subnets import SyncnetsService
+
         node_id = bytes.fromhex(self.peer_id)
         self.attnets = AttnetsService(node_id, config.preset.SLOTS_PER_EPOCH)
+        self.syncnets = SyncnetsService(config.preset.SLOTS_PER_EPOCH)
 
         self.discovery = None  # enabled via start(discovery=True)
         self._dial_backoff: dict[str, float] = {}  # node_id → retry-after
@@ -202,6 +205,24 @@ class Network:
                 topic_str = stringify_topic(GossipTopic(gtype, digest))
                 self._ensure_topic_params(topic_str)
                 await self.gossip.subscribe(topic_str)
+            # altair+ digests also carry the sync-committee topics
+            fork = self.config.fork_name_from_digest(digest)
+            if fork not in ("phase0",):
+                for gtype in (
+                    GossipType.sync_committee_contribution_and_proof,
+                    GossipType.light_client_finality_update,
+                    GossipType.light_client_optimistic_update,
+                ):
+                    topic_str = stringify_topic(GossipTopic(gtype, digest))
+                    self._ensure_topic_params(topic_str)
+                    await self.gossip.subscribe(topic_str)
+                epoch = self.chain.clock.current_epoch
+                for subnet in sorted(self.syncnets.active_subnets(epoch)):
+                    topic_str = stringify_topic(
+                        GossipTopic(GossipType.sync_committee, digest, subnet)
+                    )
+                    self._ensure_topic_params(topic_str)
+                    await self.gossip.subscribe(topic_str)
             subnets = (
                 range(64)
                 if self.subscribe_all_subnets
@@ -287,10 +308,33 @@ class Network:
             for pid in self.transport.connections
         ]
 
+    async def _refresh_subnet_subscriptions(self) -> None:
+        """Join any newly-active duty subnets (attnets short-lived +
+        syncnets membership change after start) and prune expired ones —
+        the dynamic half of the reference's subnet services."""
+        epoch = self.chain.clock.current_epoch
+        self.syncnets.prune(epoch)
+        for digest in self._fork_digests_now():
+            for subnet in self.attnets.active_subnets(epoch):
+                topic = stringify_topic(
+                    GossipTopic(GossipType.beacon_attestation, digest, subnet)
+                )
+                if topic not in self.gossip.subscriptions:
+                    await self.subscribe_subnet(subnet, digest)
+            if self.config.fork_name_from_digest(digest) != "phase0":
+                for subnet in self.syncnets.active_subnets(epoch):
+                    topic = stringify_topic(
+                        GossipTopic(GossipType.sync_committee, digest, subnet)
+                    )
+                    if topic not in self.gossip.subscriptions:
+                        self._ensure_topic_params(topic)
+                        await self.gossip.subscribe(topic)
+
     async def _heartbeat_loop(self) -> None:
         while True:
             await asyncio.sleep(HEARTBEAT_SEC)
             try:
+                await self._refresh_subnet_subscriptions()
                 # below-target: dial peers known to discovery but not yet
                 # connected (reference: PeerManager discover-on-heartbeat).
                 # Dials are concurrent, time-capped tasks, at most enough to

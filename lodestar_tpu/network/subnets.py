@@ -76,3 +76,34 @@ class AttnetsService:
             if s.until_epoch > epoch:
                 bits[s.subnet] = True
         return bits
+
+
+class SyncnetsService:
+    """Sync-committee subnet subscriptions (reference
+    `network/subnets/syncnetsService.ts`): subscriptions follow the
+    validator's sync-committee membership for whole sync-committee
+    periods — no random rotation, unlike attnets."""
+
+    SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+    def __init__(self, slots_per_epoch: int, epochs_per_period: int = 256):
+        self.spe = slots_per_epoch
+        self.epochs_per_period = epochs_per_period
+        self.subscriptions: list[Subscription] = []
+
+    def subscribe_committee_member(self, subnet: int, until_epoch: int) -> None:
+        """Called when a local validator joins a sync subcommittee."""
+        self.subscriptions.append(Subscription(subnet, until_epoch))
+
+    def prune(self, epoch: int) -> None:
+        self.subscriptions = [s for s in self.subscriptions if s.until_epoch > epoch]
+
+    def active_subnets(self, epoch: int) -> set[int]:
+        return {s.subnet for s in self.subscriptions if s.until_epoch > epoch}
+
+    def enr_syncnets(self, epoch: int) -> list[bool]:
+        bits = [False] * self.SYNC_COMMITTEE_SUBNET_COUNT
+        for s in self.subscriptions:
+            if s.until_epoch > epoch:
+                bits[s.subnet] = True
+        return bits

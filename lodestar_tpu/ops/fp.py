@@ -102,7 +102,17 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     Schoolbook convolution into 64 uncarried int32 columns (each < 2^29),
     then word-by-word Montgomery reduction as a 32-step scan. Peak column
     value stays < 2^31 (see limbs.py for the bound).
+
+    LODESTAR_TPU_PALLAS_MUL=1 routes through the Pallas VMEM-resident
+    kernel (`ops/pallas_fp.py`) instead — same contract, one HBM
+    round-trip per batch tile on TPU hardware.
     """
+    import os
+
+    if os.environ.get("LODESTAR_TPU_PALLAS_MUL") == "1":
+        from .pallas_fp import mont_mul
+
+        return mont_mul(a, b)
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (N_LIMBS,))
     b = jnp.broadcast_to(b, batch + (N_LIMBS,))

@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the Montgomery field multiply — the innermost
+hot op of the pairing pipeline (SURVEY.md §7 hard part #1).
+
+The XLA path (`ops/fp.mul`) materializes the 64-column convolution
+between HLO ops; the Pallas kernel keeps the entire schoolbook product +
+Montgomery reduction + carry propagation in VMEM for a batch tile, one
+HBM round-trip per tile.
+
+Layout: Pallas tiling wants the last axis = 128 lanes, so the kernel
+works on (limbs, batch) blocks — limbs (32/64) on the sublane axis,
+batch elements on the lane axis. The wrapper transposes from the
+framework-wide batch-leading `(..., 32)` layout, pads the batch to a
+lane multiple, and restores the layout afterwards.
+
+`interpret=True` (automatic off-TPU) runs the same kernel through the
+Pallas interpreter, so the differential suite covers it on the CPU
+backend; on TPU hardware the compiled kernel is used.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .limbs import LIMB_BITS, LIMB_MASK, N_LIMBS, N0, P_LIMBS
+
+LANES = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _mont_mul_kernel(a_ref, b_ref, p_ref, out_ref):
+    """One batch tile: a,b (N_LIMBS, LANES) int32 → REDC(a*b) (N_LIMBS, LANES).
+
+    All intermediates are VMEM values; loops are Python-static (32 limbs),
+    so the kernel unrolls into straight-line VPU code."""
+    a = a_ref[:]
+    b = b_ref[:]
+    p = p_ref[:]
+
+    # schoolbook convolution into 2*N_LIMBS uncarried int32 columns
+    t = jnp.zeros((2 * N_LIMBS, a.shape[1]), jnp.int32)
+    for i in range(N_LIMBS):
+        t = t.at[i : i + N_LIMBS, :].add(a[i : i + 1, :] * b)
+
+    # word-serial Montgomery reduction: kill one low limb per step
+    for i in range(N_LIMBS):
+        m = (t[i : i + 1, :] * N0) & LIMB_MASK
+        t = t.at[i : i + N_LIMBS, :].add(m * p)
+        carry = t[i : i + 1, :] >> LIMB_BITS
+        t = t.at[i + 1 : i + 2, :].add(carry)
+        t = t.at[i : i + 1, :].set(0)
+
+    # carry propagation over the high half → canonical 12-bit limbs
+    hi = t[N_LIMBS:, :]
+    carry = jnp.zeros((1, a.shape[1]), jnp.int32)
+    rows = []
+    for i in range(N_LIMBS):
+        v = hi[i : i + 1, :] + carry
+        rows.append(v & LIMB_MASK)
+        carry = v >> LIMB_BITS
+    out_ref[:] = jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mont_mul_tiles(a_t: jnp.ndarray, b_t: jnp.ndarray, interpret: bool):
+    """a_t, b_t: (N_LIMBS, batch_padded) — batch_padded % LANES == 0."""
+    p = jnp.asarray(P_LIMBS, jnp.int32)[:, None] * jnp.ones((1, LANES), jnp.int32)
+    n_tiles = a_t.shape[1] // LANES
+    return pl.pallas_call(
+        _mont_mul_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((N_LIMBS, LANES), lambda i: (0, i)),
+            pl.BlockSpec((N_LIMBS, LANES), lambda i: (0, i)),
+            pl.BlockSpec((N_LIMBS, LANES), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((N_LIMBS, LANES), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct(a_t.shape, jnp.int32),
+        interpret=interpret,
+    )(a_t, b_t, p)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray, interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in for `ops.fp.mul` backed by the Pallas kernel.
+
+    Accepts the framework layout `(..., N_LIMBS)` with broadcastable batch
+    axes; same [0,2p) lazy-reduction contract as fp.mul."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (N_LIMBS,)).reshape(-1, N_LIMBS)
+    b = jnp.broadcast_to(b, batch + (N_LIMBS,)).reshape(-1, N_LIMBS)
+    n = a.shape[0]
+    pad = (-n) % LANES
+    if pad:
+        a = jnp.concatenate([a, jnp.zeros((pad, N_LIMBS), a.dtype)], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((pad, N_LIMBS), b.dtype)], axis=0)
+    out_t = _mont_mul_tiles(a.T, b.T, interpret)
+    out = out_t.T[:n]
+    return out.reshape(batch + (N_LIMBS,))

@@ -13,10 +13,6 @@ from ..state_transition.cache import CachedBeaconState
 from .runner import SpecCase, SpecTestResult, run_directory_spec_test
 
 
-def _load_state(config, state_type, raw: bytes) -> CachedBeaconState:
-    return CachedBeaconState(config, state_type.deserialize(raw))
-
-
 def _run_case(case: SpecCase, config, state_type, mutate) -> None:
     pre = CachedBeaconState(config, state_type.deserialize(case.ssz("pre")))
     if case.has("post"):

@@ -115,3 +115,19 @@ def test_weak_subjectivity_period():
     # with full 32-ETH balances the ws period is at least the withdrawability delay
     assert ws >= config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
     assert is_within_weak_subjectivity_period(cached, ws_checkpoint_epoch=0)
+
+
+def test_syncnets_service_membership_subscriptions():
+    """Reference syncnetsService.ts: duty-driven subscriptions per sync
+    period, pruned on expiry, advertised via the syncnets bitfield."""
+    from lodestar_tpu.network.subnets import SyncnetsService
+
+    svc = SyncnetsService(slots_per_epoch=8)
+    svc.subscribe_committee_member(1, until_epoch=10)
+    svc.subscribe_committee_member(3, until_epoch=5)
+    assert svc.active_subnets(epoch=4) == {1, 3}
+    assert svc.enr_syncnets(epoch=4) == [False, True, False, True]
+    assert svc.active_subnets(epoch=7) == {1}
+    svc.prune(epoch=7)
+    assert len(svc.subscriptions) == 1
+    assert svc.active_subnets(epoch=11) == set()
