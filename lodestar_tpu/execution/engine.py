@@ -297,3 +297,23 @@ class ExecutionEngineHttp:
         if isinstance(result, dict) and "executionPayload" in result:
             return result["executionPayload"]
         return result
+
+    def exchange_transition_configuration(self, ttd: int, terminal_block_hash: bytes) -> bool:
+        """engine_exchangeTransitionConfigurationV1 (`engine/http.ts:308`):
+        CL and EL cross-check their merge configuration; mismatch means a
+        mis-configured pair that would fork at the transition."""
+        result = self._call(
+            "engine_exchangeTransitionConfigurationV1",
+            [
+                {
+                    "terminalTotalDifficulty": hex(ttd),
+                    "terminalBlockHash": "0x" + terminal_block_hash.hex(),
+                    "terminalBlockNumber": "0x0",
+                }
+            ],
+        )
+        if not isinstance(result, dict):
+            return False
+        got_ttd = int(str(result.get("terminalTotalDifficulty", "0x0")), 16)
+        got_hash = str(result.get("terminalBlockHash", "0x")).removeprefix("0x")
+        return got_ttd == ttd and bytes.fromhex(got_hash or "00" * 32) == terminal_block_hash

@@ -99,3 +99,63 @@ def test_eth1_vote_majority():
     state.eth1_data_votes = [candidate.copy(), candidate.copy(), types.Eth1Data()]
     vote = tracker.get_eth1_vote(state, 0)
     assert vote == candidate  # strict majority wins
+
+
+def test_merge_block_tracker_finds_terminal_block():
+    """Reference eth1MergeBlockTracker: first block crossing TTD with a
+    sub-TTD parent is terminal; cached once found."""
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.eth1.merge_tracker import Eth1MergeBlockTracker, PowProviderMock
+
+    import dataclasses
+
+    config = dataclasses.replace(MINIMAL_CHAIN_CONFIG, TERMINAL_TOTAL_DIFFICULTY=100)
+    provider = PowProviderMock()
+    provider.add_block(b"\x01" * 32, b"\x00" * 32, 50)
+    provider.add_block(b"\x02" * 32, b"\x01" * 32, 90)
+    tracker = Eth1MergeBlockTracker(config, provider)
+    assert tracker.get_terminal_pow_block() is None  # pre-merge
+
+    provider.add_block(b"\x03" * 32, b"\x02" * 32, 120)  # crosses TTD
+    provider.add_block(b"\x04" * 32, b"\x03" * 32, 150)  # descendant
+    terminal = tracker.get_terminal_pow_block()
+    assert terminal is not None and terminal.block_hash == b"\x03" * 32
+    assert tracker.is_valid_terminal_pow_block(terminal)
+    assert not tracker.is_valid_terminal_pow_block(provider.get_pow_block(b"\x04" * 32))
+    # cached: provider changes don't disturb the found terminal block
+    provider.add_block(b"\x05" * 32, b"\x04" * 32, 200)
+    assert tracker.get_terminal_pow_block().block_hash == b"\x03" * 32
+
+
+def test_exchange_transition_configuration_mock():
+    """CL/EL merge-config handshake shape (engine_exchangeTransitionConfigurationV1)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from lodestar_tpu.execution.engine import ExecutionEngineHttp
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(length))
+            assert req["method"] == "engine_exchangeTransitionConfigurationV1"
+            echo = req["params"][0]
+            raw = json.dumps({"jsonrpc": "2.0", "id": req["id"], "result": echo}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        engine = ExecutionEngineHttp("127.0.0.1", server.server_address[1], b"\x00" * 32)
+        assert engine.exchange_transition_configuration(1000, b"\x00" * 32)
+    finally:
+        server.shutdown()
+        server.server_close()
