@@ -181,6 +181,23 @@ class BeaconApiImpl:
         self.chain.op_pool.add_attester_slashing(slashing)
         return None
 
+    def prepareBeaconProposer(self, params, query, body):
+        """Fee-recipient registrations ahead of proposals (validator.ts
+        prepareBeaconProposer → beaconProposerCache)."""
+        epoch = self.chain.clock.current_epoch
+        for entry in body or []:
+            try:
+                fee_recipient = bytes.fromhex(
+                    entry["fee_recipient"].removeprefix("0x")
+                )
+                index = int(entry["validator_index"])
+            except (KeyError, ValueError, AttributeError) as e:
+                raise ApiError(400, f"malformed preparation: {e}")
+            if len(fee_recipient) != 20:
+                raise ApiError(400, "fee_recipient must be 20 bytes")
+            self.chain.beacon_proposer_cache.add(epoch, index, fee_recipient)
+        return None
+
     def getPoolProposerSlashings(self, params, query, body):
         return [s.to_obj() for s in list(self.chain.op_pool.proposer_slashings.values())]
 

@@ -53,6 +53,7 @@ API_ROUTES: list[Route] = [
     Route("getAggregatedAttestation", "GET", "/eth/v1/validator/aggregate_attestation"),
     Route("publishAggregateAndProofs", "POST", "/eth/v1/validator/aggregate_and_proofs"),
     Route("getLiveness", "POST", "/eth/v1/validator/liveness/{epoch}"),
+    Route("prepareBeaconProposer", "POST", "/eth/v1/validator/prepare_beacon_proposer"),
     # debug (routes/debug.ts)
     Route("getDebugChainHeadsV2", "GET", "/eth/v2/debug/beacon/heads"),
     Route("getStateV2", "GET", "/eth/v2/debug/beacon/states/{state_id}"),

@@ -144,6 +144,10 @@ class BeaconChain:
 
         self.prepare_next_slot = PrepareNextSlotScheduler(self)
 
+        from .proposer_cache import BeaconProposerCache
+
+        self.beacon_proposer_cache = BeaconProposerCache()
+
     # -- block import (reference chain/blocks pipeline) ----------------------
 
     def process_block(self, signed_block, verify_signatures: bool = True):
@@ -278,6 +282,7 @@ class BeaconChain:
         self.aggregated_pool.prune(post.current_epoch)
         self.sync_committee_pool.prune(block.slot)
         self.sync_contribution_pool.prune(block.slot)
+        self.beacon_proposer_cache.prune(post.current_epoch)
 
     def update_head(self) -> bytes:
         self.head_root = self.fork_choice.update_head()
@@ -342,7 +347,7 @@ class BeaconChain:
         slot: int,
         randao_reveal: bytes,
         graffiti: bytes = b"",
-        fee_recipient: bytes = b"\x00" * 20,
+        fee_recipient: bytes | None = None,
     ):
         """Assemble an unsigned block on the current head (reference
         produceBlock/produceBlockBody: pools → ops, eth1 vote, sync
@@ -359,6 +364,9 @@ class BeaconChain:
         types = fork_types(pre)
         parent_root = pre.state.latest_block_header.hash_tree_root()
         proposer = pre.epoch_ctx.get_beacon_proposer(slot)
+        if fee_recipient is None:
+            # fall back to the proposer's prepareBeaconProposer registration
+            fee_recipient = self.beacon_proposer_cache.get(proposer)
         attestations = self.aggregated_pool.get_attestations_for_block(
             types, pre, self.preset.MAX_ATTESTATIONS
         )

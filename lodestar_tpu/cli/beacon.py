@@ -180,7 +180,9 @@ def _run_networked(args, node, config, types, stop, log) -> int:
             if text:
                 bootnodes.append(enr_from_text(text))
         network = Network(
-            config, types, node.chain, identity=_load_identity(args.datadir)
+            config, types, node.chain,
+            identity=_load_identity(args.datadir),
+            metrics=node.metrics,
         )
         await network.start(
             host=args.listen_address,

@@ -56,4 +56,33 @@ def create_beacon_metrics(registry: MetricsRegistry | None = None):
     m.fork_choice_nodes = r.gauge(
         "lodestar_fork_choice_nodes", "proto-array node count"
     )
+    # network (gossipsub / reqresp / discovery — reference lodestar.ts
+    # gossipsub.* / reqResp.* metric families)
+    m.peers_connected = r.gauge("lodestar_peers_connected", "live transport connections")
+    m.gossip_mesh_peers = r.gauge(
+        "lodestar_gossip_mesh_peers", "mesh size per topic kind",
+        label_names=("kind",),
+    )
+    m.gossip_rx_total = r.counter(
+        "lodestar_gossip_messages_received_total", "gossip messages received by outcome",
+        label_names=("outcome",),
+    )
+    m.gossip_tx_total = r.counter(
+        "lodestar_gossip_messages_sent_total", "gossip messages published"
+    )
+    m.gossip_queue_length = r.gauge(
+        "lodestar_gossip_validation_queue_length", "validation queue depth",
+        label_names=("topic",),
+    )
+    m.gossip_queue_dropped_total = r.counter(
+        "lodestar_gossip_validation_queue_dropped_total", "jobs dropped at full queues",
+        label_names=("topic",),
+    )
+    m.reqresp_seconds = r.histogram(
+        "lodestar_reqresp_request_seconds", "outbound req/resp latency",
+        label_names=("protocol",),
+    )
+    m.discovery_table_size = r.gauge(
+        "lodestar_discovery_table_size", "routing table entries"
+    )
     return m

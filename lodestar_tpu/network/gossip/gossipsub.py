@@ -226,6 +226,8 @@ class Gossipsub:
         rpc = RPC(messages=[(topic, data)])
         for pid in targets:
             await self._send(pid, rpc)
+        if self.metrics is not None:
+            self.metrics.gossip_tx_total.inc()
         return len(targets)
 
     # ------------------------------------------------------------------ input
@@ -262,6 +264,8 @@ class Gossipsub:
             # not our topic: don't validate or forward
             return
         result = await self._validate(topic, data)
+        if self.metrics is not None:
+            self.metrics.gossip_rx_total.inc(outcome=result.value)
         if result is ValidationResult.REJECT:
             self.score.reject_message(peer_id, topic)
             return

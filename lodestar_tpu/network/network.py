@@ -47,7 +47,9 @@ class Network:
         identity: NodeIdentity | None = None,
         verify_signatures: bool = True,
         subscribe_all_subnets: bool = False,
+        metrics=None,
     ):
+        self.metrics = metrics
         self.config = config
         self.types = types
         self.chain = chain
@@ -59,6 +61,7 @@ class Network:
         # gossip: Ethereum score params for the topics we will join
         score_params = PeerScoreParams()
         self.gossip = Gossipsub(score_params)
+        self.gossip.metrics = metrics
         self.gossip_service = GossipsubService(self.transport, self.gossip)
         self.gossip_handlers = GossipHandlers(
             config, types, chain, verify_signatures=verify_signatures
@@ -69,7 +72,8 @@ class Network:
         # reqresp
         self.reqresp_handlers = ReqRespHandlers(config, types, chain)
         self.reqresp = ReqRespService(
-            self.transport, self.reqresp_handlers, types, self.peer_manager
+            self.transport, self.reqresp_handlers, types, self.peer_manager,
+            metrics=_ReqRespMetricsAdapter(metrics) if metrics is not None else None,
         )
 
         # subnets
@@ -81,6 +85,8 @@ class Network:
 
         self.discovery = None  # enabled via start(discovery=True)
         self._dial_backoff: dict[str, float] = {}  # node_id → retry-after
+        self._queue_drops_seen: dict[str, int] = {}  # per-topic drop watermark
+        self._mesh_kinds_seen: set[str] = set()
 
         self._heartbeat_task: asyncio.Task | None = None
         self.transport.on_connection.append(self._on_connection)
@@ -330,10 +336,41 @@ class Network:
                         self._ensure_topic_params(topic)
                         await self.gossip.subscribe(topic)
 
+    def _export_metrics(self) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        m.peers_connected.set(len(self.transport.connections))
+        if self.discovery is not None:
+            m.discovery_table_size.set(len(self.discovery.table))
+        from .gossip.topic import parse_topic
+
+        by_kind: dict[str, int] = {}
+        for topic, mesh in self.gossip.mesh.items():
+            try:
+                kind = parse_topic(topic).type.value
+            except ValueError:
+                continue
+            by_kind[kind] = by_kind.get(kind, 0) + len(mesh)
+        # zero kinds that left the mesh so stale gauge series don't linger
+        for kind in self._mesh_kinds_seen - set(by_kind):
+            m.gossip_mesh_peers.set(0, kind=kind)
+        self._mesh_kinds_seen |= set(by_kind)
+        for kind, size in by_kind.items():
+            m.gossip_mesh_peers.set(size, kind=kind)
+        for gtype, queue in self.gossip_handlers.queues.items():
+            m.gossip_queue_length.set(len(queue), topic=gtype.value)
+            seen = self._queue_drops_seen.get(gtype.value, 0)
+            dropped = queue.metrics.dropped_jobs
+            if dropped > seen:
+                m.gossip_queue_dropped_total.inc(dropped - seen, topic=gtype.value)
+                self._queue_drops_seen[gtype.value] = dropped
+
     async def _heartbeat_loop(self) -> None:
         while True:
             await asyncio.sleep(HEARTBEAT_SEC)
             try:
+                self._export_metrics()
                 await self._refresh_subnet_subscriptions()
                 # below-target: dial peers known to discovery but not yet
                 # connected (reference: PeerManager discover-on-heartbeat).
@@ -381,3 +418,13 @@ class Network:
 
     def report_peer(self, peer_id: str, action: PeerAction) -> None:
         self.peer_manager.report_peer(peer_id, action)
+
+
+class _ReqRespMetricsAdapter:
+    """Bridges ReqRespService's observe hook onto the metric registry."""
+
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    def observe_reqresp(self, protocol: str, seconds: float) -> None:
+        self._metrics.reqresp_seconds.observe(seconds, protocol=protocol)

@@ -120,3 +120,22 @@ def test_error_paths(api_env):
     assert ei.value.status == 404
     with pytest.raises(ApiClientError):
         client.getBlockV2("0x" + "ab" * 32)
+
+
+def test_prepare_beacon_proposer_feeds_block_production(api_env):
+    """prepareBeaconProposer registrations land in the proposer cache and
+    produce_block picks the registered fee recipient (reference
+    beaconProposerCache flow)."""
+    config, types, chain, _service, client = api_env
+    fee = bytes(range(20))
+    entries = [
+        {"validator_index": str(i), "fee_recipient": "0x" + fee.hex()}
+        for i in range(len(chain.head_state.state.validators))
+    ]
+    client.prepareBeaconProposer(body=entries)
+    assert len(chain.beacon_proposer_cache) == len(entries)
+    assert chain.beacon_proposer_cache.get(0) == fee
+    # pruning drops stale registrations
+    chain.beacon_proposer_cache.prune(current_epoch=10)
+    assert len(chain.beacon_proposer_cache) == 0
+    assert chain.beacon_proposer_cache.get(0) == b"\x00" * 20

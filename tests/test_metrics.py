@@ -52,3 +52,56 @@ def test_beacon_metric_set_and_http_server():
         assert 'beacon_gossip_attestation_total{outcome="ACCEPT"} 1' in body
     finally:
         server.close()
+
+
+def test_network_metrics_exported_live():
+    """Network heartbeat exports peers/mesh/queue gauges; gossip rx/tx
+    counters move with real traffic (reference: gossipsub metric family)."""
+    import asyncio
+
+    from lodestar_tpu.metrics import create_beacon_metrics
+    from lodestar_tpu.network.network import Network
+    from lodestar_tpu.network.transport import NodeIdentity
+    from tests.test_network_live import _fresh_chain, _produce_signed_block
+
+    async def main():
+        nets = []
+        for i in range(2):
+            config, types, chain = _fresh_chain()
+            net = Network(
+                config, types, chain,
+                identity=NodeIdentity.from_seed(bytes([70 + i])),
+                verify_signatures=False,
+                metrics=create_beacon_metrics(),
+            )
+            await net.start()
+            nets.append(net)
+        a, b = nets
+        try:
+            await a.connect(*b.transport.listen_addr)
+            for _ in range(3):
+                await asyncio.sleep(0.05)
+                for n in nets:
+                    await n.gossip.heartbeat()
+            signed = _produce_signed_block(a.config, a.types, a.chain, 1)
+            b.chain.clock.set_slot(1)
+            a.chain.process_block(signed, verify_signatures=False)
+            await a.publish_block(signed)
+            for _ in range(60):
+                if b.metrics.gossip_rx_total.value(outcome="ACCEPT") >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            a._export_metrics()
+            b._export_metrics()
+            assert a.metrics.peers_connected.value() == 1
+            assert a.metrics.gossip_tx_total.value() >= 1
+            assert b.metrics.gossip_rx_total.value(outcome="ACCEPT") >= 1
+            # prometheus text exposition includes the new families
+            text = a.metrics.registry.expose()
+            assert "lodestar_peers_connected 1" in text
+            assert "lodestar_gossip_messages_sent_total" in text
+        finally:
+            for n in nets:
+                await n.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 90.0))
