@@ -61,3 +61,34 @@ class BeaconApiClient:
 
         call.__name__ = route.operation_id
         return call
+
+
+def stream_events(host: str, port: int, topics=None, timeout: float = 30.0):
+    """SSE client generator (reference `eventSource.ts`): yields
+    (event_name, payload_dict) until the server closes or `timeout`
+    passes without a frame."""
+    import http.client as _http
+    import json as _json
+
+    path = "/eth/v1/events"
+    if topics:
+        path += "?topics=" + ",".join(topics)
+    conn = _http.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path, headers={"Accept": "text/event-stream"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"event stream refused: {resp.status}")
+        event_name = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                return
+            line = line.decode().rstrip("\n")
+            if line.startswith("event: "):
+                event_name = line[len("event: "):]
+            elif line.startswith("data: ") and event_name is not None:
+                yield event_name, _json.loads(line[len("data: "):])
+                event_name = None
+    finally:
+        conn.close()

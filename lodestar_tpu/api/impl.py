@@ -191,7 +191,7 @@ class BeaconApiImpl:
                     entry["fee_recipient"].removeprefix("0x")
                 )
                 index = int(entry["validator_index"])
-            except (KeyError, ValueError, AttributeError) as e:
+            except (KeyError, ValueError, AttributeError, TypeError) as e:
                 raise ApiError(400, f"malformed preparation: {e}")
             if len(fee_recipient) != 20:
                 raise ApiError(400, "fee_recipient must be 20 bytes")

@@ -30,6 +30,8 @@ class BeaconApiServer:
 
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
+                if method == "GET" and parsed.path == "/eth/v1/events":
+                    return self._handle_events(parsed)
                 route, params = match(method, parsed.path)
                 if route is None:
                     return self._send(404, {"message": "route not found"})
@@ -53,6 +55,58 @@ class BeaconApiServer:
                 if result is None:
                     return self._send(200, {})
                 return self._send(200, {"data": result})
+
+            def _handle_events(self, parsed):
+                """SSE event stream (reference `beacon/server/events.ts:25`):
+                `event: <topic>\\ndata: <json>\\n\\n` frames until the client
+                disconnects. Topics filtered by the ?topics= query."""
+                import queue as _queue
+
+                chain = getattr(impl_ref, "chain", None)
+                emitter = getattr(chain, "emitter", None)
+                if emitter is None:
+                    return self._send(501, {"message": "no event source"})
+                from ..chain.emitter import ChainEvent
+
+                # both array forms: topics=a&topics=b and topics=a,b
+                wanted = {
+                    t
+                    for key, value in parse_qsl(parsed.query)
+                    if key == "topics"
+                    for t in value.split(",")
+                    if t
+                } or {e.value for e in ChainEvent}
+                q: _queue.Queue = _queue.Queue(maxsize=256)
+
+                def on_event(event, payload):
+                    if event.value in wanted:
+                        try:
+                            q.put_nowait((event.value, payload))
+                        except _queue.Full:
+                            pass  # slow consumer: drop rather than block import
+
+                for e in ChainEvent:
+                    emitter.on(e, on_event)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    while True:
+                        try:
+                            name, payload = q.get(timeout=1.0)
+                        except _queue.Empty:
+                            self.wfile.write(b": keep-alive\n\n")
+                            self.wfile.flush()
+                            continue
+                        frame = f"event: {name}\ndata: {json.dumps(payload)}\n\n"
+                        self.wfile.write(frame.encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away
+                finally:
+                    for e in ChainEvent:
+                        emitter.off(e, on_event)
 
             def _send(self, status: int, obj):
                 payload = json.dumps(obj).encode()

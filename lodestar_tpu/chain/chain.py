@@ -148,6 +148,10 @@ class BeaconChain:
 
         self.beacon_proposer_cache = BeaconProposerCache()
 
+        from .emitter import ChainEventEmitter
+
+        self.emitter = ChainEventEmitter()
+
     # -- block import (reference chain/blocks pipeline) ----------------------
 
     def process_block(self, signed_block, verify_signatures: bool = True):
@@ -261,15 +265,39 @@ class BeaconChain:
                     self.light_client_server.on_import_block(
                         signed_block, parent_block, parent_state
                     )
+                    self._emit_light_client_updates()
                 except Exception:
                     pass  # light-client data is best-effort, never blocks import
         self.blocks[block_root] = signed_block
         self.db.block.put(block_root, signed_block)
         self.state_cache.add(state.hash_tree_root(), post, block_root=block_root)
         self.seen_block_proposers.add(block.slot, block.proposer_index)
+        prev_head = self.head_root
         self.head_state = post
         self.update_head()
         self._notify_forkchoice_to_engine()
+        from .emitter import ChainEvent
+
+        self.emitter.emit(
+            ChainEvent.block,
+            {"slot": str(int(block.slot)), "block": "0x" + block_root.hex()},
+        )
+        if self.head_root != prev_head:
+            # block.state_root is the imported state's verified root — no
+            # re-merkleization on the import hot path
+            state_root = (
+                bytes(block.state_root)
+                if self.head_root == block_root
+                else self.head_state.state.latest_block_header.state_root
+            )
+            self.emitter.emit(
+                ChainEvent.head,
+                {
+                    "slot": str(int(self.head_state.state.slot)),
+                    "block": "0x" + self.head_root.hex(),
+                    "state": "0x" + bytes(state_root).hex(),
+                },
+            )
         # prune + archive on finalization advance
         fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
         if fin_epoch > prev_finalized:
@@ -279,10 +307,32 @@ class BeaconChain:
             self.checkpoint_state_cache.prune_finalized(fin_epoch)
             self.archiver.process_finalized()
             self.bls_changes_pool.prune(post)
+            fin_root = self.fork_choice.store.finalized_checkpoint[1]
+            self.emitter.emit(
+                ChainEvent.finalized_checkpoint,
+                {"epoch": str(fin_epoch), "block": "0x" + fin_root.hex()},
+            )
         self.aggregated_pool.prune(post.current_epoch)
         self.sync_committee_pool.prune(block.slot)
         self.sync_contribution_pool.prune(block.slot)
         self.beacon_proposer_cache.prune(post.current_epoch)
+
+    def _emit_light_client_updates(self) -> None:
+        """SSE light-client events after import (reference events.ts
+        light_client_optimistic_update / finality_update topics)."""
+        from .emitter import ChainEvent
+
+        lc = self.light_client_server
+        optimistic = getattr(lc, "latest_optimistic_update", None)
+        if optimistic is not None:
+            self.emitter.emit(
+                ChainEvent.lightclient_optimistic_update, optimistic.to_obj()
+            )
+        finality = getattr(lc, "latest_finality_update", None)
+        if finality is not None:
+            self.emitter.emit(
+                ChainEvent.lightclient_finality_update, finality.to_obj()
+            )
 
     def update_head(self) -> bytes:
         self.head_root = self.fork_choice.update_head()

@@ -126,6 +126,7 @@ class GossipHandlers:
                     )
                 except Exception as e:
                     log.debug(f"gossip block import failed: {e}")
+                    _persist_invalid_ssz(signed, "block", e)
                     return ValidationResult.REJECT
             return _ACTION_TO_RESULT[result.action]
 
@@ -186,3 +187,25 @@ class GossipHandlers:
 
         # light-client updates: served, not consumed, by full nodes
         return ValidationResult.IGNORE
+
+
+def _persist_invalid_ssz(obj, kind: str, error: Exception) -> None:
+    """Debugging dump of objects that failed import (reference
+    `persistInvalidSszValue`, `chain/blocks/index.ts:117-135`): enabled by
+    LODESTAR_TPU_PERSIST_INVALID=<dir>; filenames carry kind + root."""
+    import os
+
+    target = os.environ.get("LODESTAR_TPU_PERSIST_INVALID")
+    if not target:
+        return
+    try:
+        os.makedirs(target, exist_ok=True)
+        root = obj.message.hash_tree_root().hex()[:16] if hasattr(obj, "message") else "obj"
+        path = os.path.join(target, f"invalid_{kind}_{root}.ssz")
+        with open(path, "wb") as f:
+            f.write(obj.serialize())
+        with open(path + ".log", "w") as f:
+            f.write(f"{type(error).__name__}: {error}\n")
+        log.warning("persisted invalid %s to %s", kind, path)
+    except Exception:
+        pass  # diagnostics only

@@ -120,6 +120,11 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     for i in range(N_LIMBS):  # static unroll: 32 vector multiply-adds
         t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
 
+    # Montgomery reduction as a 32-step lax.scan. A statically-unrolled
+    # variant was measured on v5e: ~3% faster at run time but it multiplies
+    # the HLO of every consumer (the full batch kernel's first compile went
+    # from ~3 min to >20 min) — the scan keeps the graph compact, which is
+    # the right trade for a kernel compiled per batch-bucket.
     def redc_step(t, i):
         chunk = lax.dynamic_slice_in_dim(t, i, N_LIMBS, axis=-1)
         m = (chunk[..., 0:1] * N0) & LIMB_MASK

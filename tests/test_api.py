@@ -139,3 +139,57 @@ def test_prepare_beacon_proposer_feeds_block_production(api_env):
     chain.beacon_proposer_cache.prune(current_epoch=10)
     assert len(chain.beacon_proposer_cache) == 0
     assert chain.beacon_proposer_cache.get(0) == b"\x00" * 20
+
+
+def test_event_stream_sse(api_env):
+    """SSE /eth/v1/events delivers head/block events fired by block import
+    (reference events.ts + eventSource.ts)."""
+    import queue
+    import threading
+
+    from lodestar_tpu.api.client import stream_events
+    from tests.test_chain import _sign_block, _sk
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.block import _epoch_signing_root
+    from lodestar_tpu.params import DOMAIN_RANDAO
+
+    config, types, chain, _service, client = api_env
+    got: "queue.Queue" = queue.Queue()
+
+    def consume():
+        try:
+            for name, payload in stream_events(
+                "127.0.0.1", client.port, topics=["head", "block"], timeout=15
+            ):
+                got.put((name, payload))
+        except Exception as e:
+            got.put(("error", {"message": str(e)}))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time as _time
+
+    _time.sleep(0.3)  # let the subscriber attach
+
+    slot = chain.head_state.state.slot + 1
+    chain.clock.set_slot(slot)
+    trial = chain.head_state.copy()
+    if slot > trial.state.slot:
+        process_slots(trial, types, slot)
+    proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+    reveal = _sk(proposer).sign(
+        _epoch_signing_root(slot // config.preset.SLOTS_PER_EPOCH,
+                            config.get_domain(DOMAIN_RANDAO, slot))
+    ).to_bytes()
+    block = chain.produce_block(slot, randao_reveal=reveal)
+    signed = _sign_block(config, types, block)
+    chain.process_block(signed, verify_signatures=False)
+
+    names = set()
+    for _ in range(2):
+        try:
+            name, payload = got.get(timeout=10)
+        except queue.Empty:
+            break
+        names.add(name)
+    assert "block" in names or "head" in names, f"no events received: {names}"
