@@ -1,0 +1,89 @@
+"""Micro-benchmark harness for perf suites.
+
+Reference: `@dapplion/benchmark` + `.benchrc.yaml` — per-case timed runs
+with warmup, ops/sec reporting, and a relative regression gate: results
+persist to a JSON history file and a case fails when it regresses more
+than `threshold`× against its recorded best (the reference gates at 3×
+vs branch history since no absolute numbers are committed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class BenchResult:
+    name: str
+    ops_per_sec: float
+    seconds_per_op: float
+    runs: int
+
+
+class BenchRunner:
+    def __init__(
+        self,
+        history_path: str | None = None,
+        threshold: float = 3.0,
+        min_runs: int = 5,
+        max_seconds: float = 5.0,
+    ):
+        self.history_path = history_path
+        self.threshold = threshold
+        self.min_runs = min_runs
+        self.max_seconds = max_seconds
+        self.results: list[BenchResult] = []
+        self._history = {}
+        if history_path and os.path.exists(history_path):
+            try:
+                with open(history_path) as f:
+                    self._history = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                self._history = {}
+
+    def run(self, name: str, fn, *args) -> BenchResult:
+        fn(*args)  # warmup
+        runs = 0
+        t_start = time.perf_counter()
+        while runs < self.min_runs or (
+            time.perf_counter() - t_start < self.max_seconds
+            and runs < 10_000
+        ):
+            fn(*args)
+            runs += 1
+            if time.perf_counter() - t_start >= self.max_seconds:
+                break
+        total = time.perf_counter() - t_start
+        result = BenchResult(
+            name=name,
+            ops_per_sec=runs / total,
+            seconds_per_op=total / runs,
+            runs=runs,
+        )
+        self.results.append(result)
+        return result
+
+    def check_regressions(self) -> list[str]:
+        """Names regressing > threshold× vs recorded best (empty = pass)."""
+        failures = []
+        for r in self.results:
+            best = self._history.get(r.name)
+            if best and r.seconds_per_op > best * self.threshold:
+                failures.append(
+                    f"{r.name}: {r.seconds_per_op:.6f}s/op vs best {best:.6f} "
+                    f"(> {self.threshold}x)"
+                )
+        return failures
+
+    def save_history(self) -> None:
+        if not self.history_path:
+            return
+        for r in self.results:
+            best = self._history.get(r.name)
+            if best is None or r.seconds_per_op < best:
+                self._history[r.name] = r.seconds_per_op
+        with open(self.history_path, "w") as f:
+            json.dump(self._history, f, indent=1, sort_keys=True)

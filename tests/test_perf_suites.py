@@ -1,0 +1,120 @@
+"""Perf suites (reference §4.3: `test/perf/**` with @dapplion/benchmark).
+
+Shapes mirror the reference's key suites: `bls.test.ts` (verify /
+verifyMultipleSignatures 8/32 / aggregatePubkeys 32/128),
+`attestation.test.ts` (validateGossipAttestation end-to-end), and
+state-transition perf. Run with LODESTAR_TPU_PERF=1; by default each
+case executes once (smoke) so CI stays fast — like the reference,
+regression tracking is RELATIVE via the saved history file, no absolute
+numbers are asserted.
+"""
+
+import os
+
+import pytest
+
+from lodestar_tpu.utils.benchmark import BenchRunner
+
+PERF = os.environ.get("LODESTAR_TPU_PERF") == "1"
+HISTORY = os.path.join(os.path.dirname(__file__), "..", ".bench_history.json")
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = BenchRunner(
+        history_path=HISTORY if PERF else None,
+        min_runs=3 if PERF else 1,
+        max_seconds=3.0 if PERF else 0.0,
+    )
+    yield r
+    if PERF:
+        failures = r.check_regressions()
+        r.save_history()
+        assert not failures, failures
+    for res in r.results:
+        print(f"  {res.name}: {res.ops_per_sec:.1f} ops/s ({res.runs} runs)")
+
+
+@pytest.fixture(scope="module")
+def bls_sets():
+    from lodestar_tpu.bls import api as bls
+
+    sets = []
+    for i in range(8):
+        sk = bls.interop_secret_key(i)
+        msg = bytes([i]) * 32
+        sets.append(
+            bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+    return sets
+
+
+def test_perf_bls_verify_single(runner, bls_sets):
+    from lodestar_tpu.bls import api as bls
+
+    s = bls_sets[0]
+    sig = bls.Signature.from_bytes(s.signature)
+    runner.run("bls/verify", lambda: bls.verify(s.pubkey, s.message, sig))
+
+
+def test_perf_bls_verify_multiple_8(runner, bls_sets):
+    from lodestar_tpu.bls import api as bls
+
+    runner.run(
+        "bls/verifyMultipleSignatures/8",
+        lambda: bls.verify_signature_sets(bls_sets),
+    )
+
+
+def test_perf_aggregate_pubkeys_32(runner):
+    from lodestar_tpu.bls import api as bls
+
+    pks = [bls.interop_secret_key(i).to_public_key() for i in range(32)]
+    runner.run("bls/aggregatePubkeys/32", lambda: bls.aggregate_pubkeys(pks))
+
+
+def test_perf_gossip_attestation_validation(runner):
+    """validateGossipAttestation end-to-end on a 16-validator state
+    (reference attestation.test.ts:19-25 uses 64)."""
+    from lodestar_tpu.chain.validation import (
+        compute_subnet_for_attestation,
+        validate_gossip_attestation,
+    )
+    from lodestar_tpu.chain.bls_verifier import MockBlsVerifier
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.params.presets import MINIMAL
+    from tests.test_network_gossip import _make_single_attestation
+    from tests.test_network_live import _fresh_chain
+
+    config, types, chain = _fresh_chain()
+    chain.bls = MockBlsVerifier()  # isolate the validation ladder itself
+    chain.clock.set_slot(1)
+    att, _ = _make_single_attestation(config, types, chain)
+    subnet = compute_subnet_for_attestation(
+        chain.head_state.epoch_ctx, 0, 0, MINIMAL
+    )
+
+    def once():
+        chain.seen_attesters._by_epoch.clear()  # re-validate, not IGNORE
+        return validate_gossip_attestation(chain, types, att, subnet)
+
+    result = once()
+    runner.run("chain/validateGossipAttestation", once)
+
+
+def test_perf_epoch_transition(runner):
+    from lodestar_tpu.state_transition import process_slots
+    from tests.test_network_live import _fresh_chain
+
+    config, types, chain = _fresh_chain()
+    spe = config.preset.SLOTS_PER_EPOCH
+
+    def once():
+        st = chain.head_state.copy()
+        process_slots(st, types, spe)
+
+    runner.run("state-transition/epoch-transition/16-validators", once)
