@@ -21,12 +21,14 @@ class RestValidatorService:
         client,
         store: ValidatorStore,
         doppelganger: DoppelgangerService | None = None,
+        fee_recipient: bytes | None = None,
     ):
         self.config = config
         self.types = types
         self.client = client
         self.store = store
         self.doppelganger = doppelganger
+        self.fee_recipient = fee_recipient
         self.log = get_logger("validator")
         self._indices: dict[bytes, int] = {}  # pubkey → validator index
         self._attester_duties: dict[int, list[dict]] = {}  # slot → duties
@@ -70,6 +72,21 @@ class RestValidatorService:
             if int(duty["validator_index"]) in ours:
                 self._proposer_duties[int(duty["slot"])] = int(duty["validator_index"])
         self._duties_epoch = epoch
+        if self.fee_recipient is not None:
+            # re-register every epoch: the node-side proposer cache expires
+            # stale registrations (reference prepareBeaconProposerService)
+            try:
+                self.client.prepareBeaconProposer(
+                    body=[
+                        {
+                            "validator_index": str(i),
+                            "fee_recipient": "0x" + self.fee_recipient.hex(),
+                        }
+                        for i in indices.values()
+                    ]
+                )
+            except Exception as e:
+                self.log.warning("prepareBeaconProposer failed: %s", e)
         self.log.info(
             "duties epoch %d: %d attester slots, %d proposals",
             epoch,

@@ -166,3 +166,28 @@ def test_checkpoint_sync_and_db_resume(node_env):
     node2 = BeaconNode.init(config, types, state, NodeOptions(rest=False))
     assert node2.chain.head_state.state.slot == state.slot
     node2.close()
+
+
+def test_rest_validator_registers_fee_recipient(node_env):
+    """The validator client re-registers its fee recipient each duty
+    refresh; block production then pays it (prepareBeaconProposerService)."""
+    from lodestar_tpu.api.client import BeaconApiClient
+    from lodestar_tpu.bls import api as bls
+    from lodestar_tpu.db.controller import MemoryDb
+    from lodestar_tpu.validator import (
+        RestValidatorService,
+        SlashingProtection,
+        ValidatorStore,
+    )
+
+    config, types, node = node_env
+    fee = bytes(range(20))
+    client = BeaconApiClient("127.0.0.1", node.api_server.port)
+    store = ValidatorStore(config, SlashingProtection(MemoryDb()))
+    for i in range(4):
+        store.add_secret_key(bls.interop_secret_key(i))
+    service = RestValidatorService(config, types, client, store, fee_recipient=fee)
+    service.update_duties(node.chain.head_state.epoch_ctx.current_epoch)
+    for i in range(4):
+        assert node.chain.beacon_proposer_cache.get(i) == fee
+    assert node.chain.beacon_proposer_cache.get(7) == b"\x00" * 20
