@@ -49,10 +49,23 @@ class Repository(Generic[T]):
     serialize/deserialize (any SSZType); ids are raw bytes (roots) or
     uint64-BE slots for ordered range scans."""
 
+    # class-level op counters by (bucket, op) — the reference records
+    # per-repository db operation metrics (db pkg "per-op metrics") that
+    # feed lodestar_db_* families; exposed via snapshot_op_metrics()
+    _op_counts: dict[tuple[int, str], int] = {}
+
     def __init__(self, db, bucket: Bucket, ssz_type):
         self.db = db
         self.bucket = int(bucket)
         self.type = ssz_type
+
+    def _count(self, op: str) -> None:
+        key = (self.bucket, op)
+        Repository._op_counts[key] = Repository._op_counts.get(key, 0) + 1
+
+    @classmethod
+    def snapshot_op_metrics(cls) -> dict[tuple[int, str], int]:
+        return dict(cls._op_counts)
 
     # -- keys ----------------------------------------------------------------
 
@@ -66,6 +79,7 @@ class Repository(Generic[T]):
     # -- ops -----------------------------------------------------------------
 
     def get(self, id_: bytes) -> T | None:
+        self._count("get")
         raw = self.db.get(self._key(id_))
         return self.type.deserialize(raw) if raw is not None else None
 
@@ -76,15 +90,18 @@ class Repository(Generic[T]):
         return self.db.get(self._key(id_)) is not None
 
     def put(self, id_: bytes, value: T) -> None:
+        self._count("put")
         self.db.put(self._key(id_), self.type.serialize(value))
 
     def put_binary(self, id_: bytes, raw: bytes) -> None:
         self.db.put(self._key(id_), raw)
 
     def delete(self, id_: bytes) -> None:
+        self._count("delete")
         self.db.delete(self._key(id_))
 
     def batch_put(self, items: list[tuple[bytes, T]]) -> None:
+        self._count("batch_put")
         self.db.batch_put(
             [(self._key(i), self.type.serialize(v)) for i, v in items]
         )

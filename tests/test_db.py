@@ -80,3 +80,20 @@ def test_beacon_db_archive_index():
     # slot-ordered stream
     slots = [b.message.slot for b in bdb.block_archive.values_stream()]
     assert slots == [10, 11]
+
+
+def test_repository_op_metrics_counted():
+    """Per-op counters by bucket (reference db per-op metrics)."""
+    from lodestar_tpu.db.controller import MemoryDb
+    from lodestar_tpu.db.repository import Bucket, Repository
+    from lodestar_tpu.ssz import uint64
+
+    repo = Repository(MemoryDb(), Bucket.allForks_block, uint64)
+    before = Repository.snapshot_op_metrics()
+    repo.put(b"\x01" * 8, 7)
+    repo.get(b"\x01" * 8)
+    repo.get(b"\x02" * 8)
+    after = Repository.snapshot_op_metrics()
+    bucket = int(Bucket.allForks_block)
+    assert after.get((bucket, "put"), 0) - before.get((bucket, "put"), 0) == 1
+    assert after.get((bucket, "get"), 0) - before.get((bucket, "get"), 0) == 2

@@ -201,4 +201,6 @@ def test_network_auto_dials_discovered_peers():
             for net in nets:
                 await net.stop()
 
-    run(main())
+    # dial backoff is 5-10 s/retry; under suite load convergence can
+    # exceed the shared 60 s run() budget — give this one more headroom
+    asyncio.run(asyncio.wait_for(main(), 180.0))

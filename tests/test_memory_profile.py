@@ -1,0 +1,68 @@
+"""Memory profiling harness (reference §4.7: `beacon-node/test/memory/`).
+
+tracemalloc-based growth checks on the hot in-memory structures: repeated
+state copies and cache churn must not leak — the role of the reference's
+heap-profiling scripts.
+"""
+
+import gc
+import tracemalloc
+
+import pytest
+
+
+def _measure_growth(fn, cycles=6, warmup=2):
+    """Peak RSS-ish growth (tracemalloc current bytes) across cycles after
+    warmup; returns bytes grown between cycle `warmup` and the last."""
+    for _ in range(warmup):
+        fn()
+    gc.collect()
+    tracemalloc.start()
+    baseline = None
+    for i in range(cycles):
+        fn()
+        gc.collect()
+        current, _peak = tracemalloc.get_traced_memory()
+        if baseline is None:
+            baseline = current
+    growth = current - baseline
+    tracemalloc.stop()
+    return growth
+
+
+def test_state_copy_does_not_leak():
+    from tests.test_network_live import _fresh_chain
+
+    config, types, chain = _fresh_chain()
+
+    def cycle():
+        st = chain.head_state.copy()
+        st.sync_flat()
+
+    growth = _measure_growth(cycle)
+    assert growth < 2_000_000, f"state copies leak: {growth} bytes over cycles"
+
+
+def test_state_cache_bounded():
+    """StateContextCache must evict at its max size (reference LRU 96)."""
+    from lodestar_tpu.chain.state_cache import StateContextCache
+    from tests.test_network_live import _fresh_chain
+
+    config, types, chain = _fresh_chain()
+    cache = StateContextCache()
+    st = chain.head_state
+    cap = cache.max_states
+    for i in range(cap + 20):
+        cache.add(i.to_bytes(32, "big"), st, block_root=i.to_bytes(32, "big"))
+    assert len(cache._cache) <= cap
+
+
+def test_seen_caches_prune_bounded():
+    from lodestar_tpu.chain.seen_cache import SeenAttesters
+
+    seen = SeenAttesters()
+    for epoch in range(50):
+        for idx in range(64):
+            seen.add(epoch, idx)
+    seen.prune(finalized_epoch=48)
+    assert set(seen._by_epoch) == {48, 49}
