@@ -192,6 +192,9 @@ def _run_networked(args, node, config, types, stop, log) -> int:
             advertise_ip=args.advertise_ip,
         )
         node.attach_network(network)
+        # merge persisted peers from the last run into the table (reference:
+        # libp2p datastore persistence, network/peers/datastore.ts)
+        _load_peerstore(args.datadir, network)
         enr_text = enr_to_text(network.discovery.local_enr)
         log.info("p2p listening on %s, peer id %s", network.transport.listen_addr, network.peer_id[:16])
         log.info("ENR: %s", enr_text)
@@ -219,11 +222,62 @@ def _run_networked(args, node, config, types, stop, log) -> int:
                 await asyncio.sleep(clock.nap())
             return 0
         finally:
+            _save_peerstore(args.datadir, network)
             await network.stop()
             node.close()
             log.info("node stopped; state persisted")
 
     return asyncio.run(main())
+
+
+def _peerstore_path(datadir):
+    import os
+
+    if not datadir or not os.path.isdir(datadir):
+        return None
+    return os.path.join(datadir, "peerstore.txt")
+
+
+def _save_peerstore(datadir, network) -> None:
+    from ..network.discovery import enr_to_text
+
+    path = _peerstore_path(datadir)
+    if path is None or network.discovery is None:
+        return
+    try:
+        with open(path, "w") as f:
+            for enr in network.discovery.table.all():
+                f.write(enr_to_text(enr) + "\n")
+    except OSError:
+        pass
+
+
+def _load_peerstore(datadir, network) -> None:
+    import os
+
+    from ..network.discovery import enr_from_text
+
+    path = _peerstore_path(datadir)
+    if path is None or network.discovery is None or not os.path.exists(path):
+        return
+    loaded = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    enr = enr_from_text(line)
+                except ValueError:
+                    continue
+                network.discovery._known_keys[enr.node_id] = enr.pubkey
+                if network.discovery.table.update(enr):
+                    loaded += 1
+    except OSError:
+        return
+    if loaded:
+        get_logger("beacon").info("restored %d peers from peerstore", loaded)
 
 
 def _load_identity(datadir):

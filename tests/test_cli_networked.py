@@ -110,3 +110,45 @@ def test_two_cli_nodes_peer_up(tmp_path):
     finally:
         a.terminate()
         a.wait(timeout=15)
+
+
+def test_peerstore_persists_across_restart(tmp_path):
+    """Known peers are saved to the datadir and restored into the routing
+    table on restart (reference peer datastore persistence)."""
+    import asyncio
+
+    from lodestar_tpu.cli.beacon import _load_peerstore, _save_peerstore
+    from lodestar_tpu.network.discovery import ENR, Discovery
+    from lodestar_tpu.network.transport import NodeIdentity
+
+    class FakeNet:
+        def __init__(self, discovery):
+            self.discovery = discovery
+
+    async def main():
+        me = NodeIdentity.from_seed(b"store-me")
+        other = NodeIdentity.from_seed(b"store-other")
+        d = Discovery(
+            me,
+            ENR(node_id=me.peer_id, pubkey=me.public_bytes,
+                ip="127.0.0.1", tcp_port=9000, udp_port=9001),
+        )
+        other_enr = ENR(
+            node_id=other.peer_id, pubkey=other.public_bytes,
+            ip="127.0.0.1", tcp_port=9002, udp_port=9003,
+        ).sign(other)
+        assert d.table.update(other_enr)
+        _save_peerstore(str(tmp_path), FakeNet(d))
+
+        # fresh process: empty table, restore from disk
+        d2 = Discovery(
+            me,
+            ENR(node_id=me.peer_id, pubkey=me.public_bytes,
+                ip="127.0.0.1", tcp_port=9000, udp_port=9001),
+        )
+        assert len(d2.table) == 0
+        _load_peerstore(str(tmp_path), FakeNet(d2))
+        restored = {e.node_id for e in d2.table.all()}
+        assert other.peer_id in restored
+
+    asyncio.run(main())
