@@ -209,3 +209,30 @@ class Lightclient:
                 attested, update.sync_aggregate, update.signature_slot
             )
             self.optimistic_header = attested.copy()
+
+    def process_finality_update(self, update) -> None:
+        """SSE finality updates: verified finality proof + aggregate
+        advance the finalized header (reference processFinalizedUpdate)."""
+        _require(self.finalized_header is not None, "not bootstrapped")
+        finalized = update.finalized_header
+        if finalized.slot <= self.finalized_header.slot:
+            return  # stale
+        _require(
+            _verify_branch(
+                finalized.hash_tree_root(),
+                update.finality_branch,
+                FINALIZED_ROOT_GINDEX,
+                FINALIZED_ROOT_DEPTH,
+                bytes(update.attested_header.state_root),
+            ),
+            "invalid finality proof",
+        )
+        self._verify_sync_aggregate(
+            update.attested_header, update.sync_aggregate, update.signature_slot
+        )
+        self.finalized_header = finalized.copy()
+        if (
+            self.optimistic_header is None
+            or update.attested_header.slot > self.optimistic_header.slot
+        ):
+            self.optimistic_header = update.attested_header.copy()
