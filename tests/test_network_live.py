@@ -100,8 +100,10 @@ def test_block_propagates_and_imports_across_three_nodes():
             sent = await a.publish_block(signed)
             assert sent >= 1
             root = signed.message.hash_tree_root()
-            for _ in range(100):
-                if all(net.chain.fork_choice.has_block(root) for net in nets):
+            # wait for HEAD convergence, not just block presence: has_block
+            # flips mid-import, before update_head finishes on that node
+            for _ in range(200):
+                if all(net.chain.head_root == root for net in nets):
                     break
                 await asyncio.sleep(0.05)
             for net in nets:
