@@ -1,0 +1,113 @@
+"""Experimental MXU-mapped field multiply (the BASELINE.md plan).
+
+Two structural changes vs `fp.mul`:
+
+1. **Convolutions as fixed matmuls.** The 32-limb schoolbook product is
+   `t[k] = Σ_{i+j=k} a_i·b_j` — an outer product (VPU) followed by a
+   contraction with a FIXED 0/1 tensor, i.e. one `(B,1024) @ (1024,64)`
+   matmul with a constant matrix — MXU work. Products are ≤ 2^24, so
+   each is split into two 12-bit halves whose matmul partial sums stay
+   ≤ 2^17 — exactly representable in f32 (24-bit mantissa): the MXU
+   computes bit-exact integer results.
+
+2. **Full-width Montgomery reduction.** Instead of the word-serial
+   32-step REDC scan, the textbook full-radix form:
+       m = (t mod R)·N' mod R,   result = (t + m·p) / R
+   with N' = -p^{-1} mod R precomputed at full width. Both extra
+   products are the same fixed-matmul convolution — the only sequential
+   work left is carry propagation (three `lax.scan` passes of cheap
+   add/shift steps).
+
+Contract matches `fp.mul`: inputs < 2p (lazy domain), output < 2p.
+Proof of the output bound: t < (2p)² so t/R < 4p²/R < p (R = 2^384 >
+4p); m·p/R < p; result < 2p. ✓
+
+Measured (v5e, 100 chained muls @4096 lanes): 119 ms vs 112 ms for the
+VPU scan path — no win yet. Two identified levers for a next round:
+(a) 6-bit limb splits make DEFAULT-precision bf16 matmuls exact
+(4 single-pass matmuls instead of 2 six-pass HIGHEST ones), and
+(b) log-depth carry-lookahead to replace the three sequential carry
+scans (160 scan steps vs the VPU path's 32). Kept as a correct,
+differential-tested experiment — not wired into the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..bls.fields import P as _P_INT
+from .limbs import LIMB_BITS, LIMB_MASK, N_LIMBS, P_LIMBS, R_MONT, int_to_limbs
+
+# full-width -p^-1 mod R as 32 12-bit limbs
+_NPRIME_INT = (-pow(_P_INT, -1, R_MONT)) % R_MONT
+_NPRIME = jnp.asarray(int_to_limbs(_NPRIME_INT))
+_P = jnp.asarray(P_LIMBS)
+
+
+def _conv_matrix() -> np.ndarray:
+    """(N²,2N) 0/1 f32: flattened outer-product index (i,j) → column i+j."""
+    s = np.zeros((N_LIMBS * N_LIMBS, 2 * N_LIMBS), np.float32)
+    for i in range(N_LIMBS):
+        for j in range(N_LIMBS):
+            s[i * N_LIMBS + j, i + j] = 1.0
+    return s
+
+
+_S = jnp.asarray(_conv_matrix())
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Column convolution of 12-bit limb vectors via the fixed matmul.
+
+    a, b: (..., N) canonical 12-bit limbs → (..., 2N) int32 columns
+    (≤ 32·2^24 — the caller's bound analysis keeps totals in int32)."""
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (N_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (N_LIMBS,))
+    outer = (a[..., :, None] * b[..., None, :]).reshape(batch + (N_LIMBS * N_LIMBS,))
+    lo = (outer & LIMB_MASK).astype(jnp.float32)
+    hi = (outer >> LIMB_BITS).astype(jnp.float32)
+    # HIGHEST precision: TPU default matmul precision is bf16 (8-bit
+    # mantissa), which destroys the exact-integer contract; the multi-pass
+    # HIGHEST mode reproduces full f32 products, exact for these ranges
+    conv_lo = jnp.matmul(
+        lo, _S, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST
+    )
+    conv_hi = jnp.matmul(
+        hi, _S, preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST
+    )
+    return conv_lo.astype(jnp.int32) + (conv_hi.astype(jnp.int32) << LIMB_BITS)
+
+
+def _carry(t: jnp.ndarray) -> jnp.ndarray:
+    """Propagate carries over the trailing limb axis; keeps ALL limbs plus
+    returns the final carry folded into an extra limb."""
+    tt = jnp.moveaxis(t, -1, 0)
+
+    def step(carry, col):
+        v = col + carry
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    final_carry, out = lax.scan(step, jnp.zeros(tt.shape[1:], jnp.int32), tt)
+    return jnp.moveaxis(out, 0, -1), final_carry
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product REDC(a·b) via MXU convolutions; contract as
+    fp.mul (inputs < 2p, output < 2p)."""
+    # t = a·b, fully carried to canonical limbs (values < (2p)² < R²)
+    t_cols = _conv(a, b)
+    t, t_carry = _carry(t_cols)  # t_carry == 0: (2p)² < 2^768 exactly fits 64 limbs
+
+    # m = (t mod R)·N' mod R — low half convolution, carried, truncated
+    m_cols = _conv(t[..., :N_LIMBS], _NPRIME)[..., :N_LIMBS]
+    m, _ = _carry(m_cols)  # mod R = drop the out-carry
+
+    # u = m·p; t + u ≡ 0 mod R ⇒ (t + u)/R is exact after carrying
+    u_cols = _conv(m, _P)
+    total = t_cols + u_cols  # columns ≤ 2·32·2^24 < 2^30: still int32-safe
+    summed, _out = _carry(total)  # t+u < 2^766 fits 64 limbs: no out-carry
+    # low 32 limbs are ≡ 0 by construction of m; result = (t+u) >> 384
+    return summed[..., N_LIMBS:]

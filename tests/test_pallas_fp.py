@@ -73,3 +73,27 @@ def test_pallas_mul_multi_axis_batch():
     want = np.asarray(fp.mul(a3, b3))
     assert got.shape == (3, 4, N_LIMBS)
     assert np.array_equal(got, want)
+
+
+def test_mxu_mul_matches_oracle():
+    """Experimental MXU-mapped Montgomery mul (ops/mxu_fp.py): exact
+    against the big-int oracle and bit-compatible with fp.mul's domain."""
+    import random
+
+    import numpy as np
+
+    from lodestar_tpu.ops import mxu_fp
+    from lodestar_tpu.ops.limbs import R_MONT, int_to_limbs, limbs_to_int
+
+    rng = random.Random(23)
+    n = 10
+    a_vals = [rng.randrange(2 * P) for _ in range(n)]
+    b_vals = [rng.randrange(2 * P) for _ in range(n)]
+    a = np.stack([int_to_limbs(v) for v in a_vals])
+    b = np.stack([int_to_limbs(v) for v in b_vals])
+    got = np.asarray(mxu_fp.mul(a, b))
+    r_inv = pow(R_MONT, -1, P)
+    for i in range(n):
+        value = limbs_to_int(got[i])
+        assert value < 2 * P
+        assert value % P == (a_vals[i] * b_vals[i] * r_inv) % P
