@@ -113,6 +113,10 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         from .pallas_fp import mont_mul
 
         return mont_mul(a, b)
+    if os.environ.get("LODESTAR_TPU_MXU_MUL") == "1":
+        from . import mxu_fp
+
+        return mxu_fp.mul(a, b)
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (N_LIMBS,))
     b = jnp.broadcast_to(b, batch + (N_LIMBS,))
@@ -214,3 +218,4 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
 def sqrt_candidate(a: jnp.ndarray) -> jnp.ndarray:
     """a^((p+1)/4) — a square root iff a is a QR (p ≡ 3 mod 4)."""
     return pow_const(a, (_P_INT + 1) // 4)
+
