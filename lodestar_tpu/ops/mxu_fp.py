@@ -26,10 +26,13 @@ Measured (v5e, 100 chained muls @4096 lanes): the first cut used
 two six-pass HIGHEST-precision matmuls and lost (119 ms vs 112 ms);
 splitting products into three 8-bit parts makes single-pass
 DEFAULT-precision (bf16-input, f32-accumulate) matmuls bit-exact and
-WINS: 95 ms vs 104 ms (~9% faster than the VPU scan path). The
-remaining lever is log-depth carry-lookahead for the three sequential
-carry scans (160 steps vs the VPU path's 32). Opt-in via
-LODESTAR_TPU_MXU_MUL=1; the differential suite pins it either way.
+WINS: 95 ms vs 104 ms (~9% faster than the VPU scan path). Replacing
+the three sequential carry scans with shift-folds + a Kogge-Stone
+prefix (log-depth, ~9 parallel steps) measured perf-neutral at this
+shape (96.6 vs 95.1 ms) but removes the 160-step sequential chain —
+kept for its asymptotics. Opt-in via LODESTAR_TPU_MXU_MUL=1; the
+differential suite pins every piece (lookahead vs scan, mul vs the
+big-int oracle) either way.
 """
 
 from __future__ import annotations
@@ -87,8 +90,49 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _carry(t: jnp.ndarray) -> jnp.ndarray:
-    """Propagate carries over the trailing limb axis; keeps ALL limbs plus
-    returns the final carry folded into an extra limb."""
+    """Log-depth carry propagation (carry-lookahead), dropping the final
+    out-carry (callers' bound analysis guarantees it is irrelevant).
+
+    Columns are < 2^30. Three shift-folds bring every limb into
+    [0, 2^12]: the first fold's carries are ≤ 2^18, the second's ≤ 2^7,
+    the third's ≤ 1. What remains is a bit-carry adder solved by a
+    Kogge-Stone generate/propagate prefix in ⌈log2(n)⌉ steps — ~9
+    parallel steps total instead of an n-step sequential scan."""
+    mask = LIMB_MASK
+
+    def fold(x):
+        carries = x >> LIMB_BITS
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(carries[..., :1]), carries[..., :-1]], axis=-1
+        )
+        return (x & mask) + shifted
+
+    v = fold(fold(fold(t)))  # limbs ∈ [0, 2^12]
+    # generate: limb overflows on its own; propagate: a carry-in ripples
+    g = v > mask
+    p = v == mask
+    # Kogge-Stone prefix over c_{i+1} = g_i | (p_i & c_i)
+    n = t.shape[-1]
+    shift = 1
+    while shift < n:
+        g_prev = jnp.concatenate(
+            [jnp.zeros_like(g[..., :shift]), g[..., :-shift]], axis=-1
+        )
+        p_prev = jnp.concatenate(
+            [jnp.zeros_like(p[..., :shift]), p[..., :-shift]], axis=-1
+        )
+        g = g | (p & g_prev)
+        p = p & p_prev
+        shift *= 2
+    carry_in = jnp.concatenate(
+        [jnp.zeros_like(g[..., :1]), g[..., :-1]], axis=-1
+    ).astype(jnp.int32)
+    out = (v + carry_in) & mask
+    return out, None
+
+
+def _carry_scan(t: jnp.ndarray):
+    """Reference sequential carry (kept for differential testing)."""
     tt = jnp.moveaxis(t, -1, 0)
 
     def step(carry, col):

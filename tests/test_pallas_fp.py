@@ -97,3 +97,22 @@ def test_mxu_mul_matches_oracle():
         value = limbs_to_int(got[i])
         assert value < 2 * P
         assert value % P == (a_vals[i] * b_vals[i] * r_inv) % P
+
+
+def test_mxu_carry_lookahead_matches_scan():
+    """Log-depth carry propagation ≡ the sequential scan, including the
+    adversarial full-ripple case."""
+    import numpy as np
+
+    from lodestar_tpu.ops import mxu_fp
+
+    rng = np.random.default_rng(4)
+    t = rng.integers(0, 1 << 30, size=(5, 64), dtype=np.int64).astype(np.int32)
+    a, _ = mxu_fp._carry(t)
+    b, _ = mxu_fp._carry_scan(t)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    ripple = np.full((1, 64), (1 << 12) - 1, np.int32)
+    ripple[0, 0] = 1 << 12
+    a, _ = mxu_fp._carry(ripple)
+    b, _ = mxu_fp._carry_scan(ripple)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
