@@ -1,0 +1,52 @@
+"""JAX platform/mesh environment setup (shared by tests and driver entry).
+
+Forcing a platform must happen BEFORE jax initializes its backends: the
+ambient environment may point JAX_PLATFORMS at a single-chip TPU tunnel
+that can neither provide n devices nor tolerate a second client claim.
+These helpers own the process-global env (JAX_PLATFORMS, XLA_FLAGS, live
+jax config) — callers that need the ambient platform afterwards must run
+in a fresh process.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = ["force_platform"]
+
+
+def force_platform(platform: str, n_devices: int | None = None) -> None:
+    """Force the JAX platform (and, for cpu, a virtual device count).
+
+    Safe no-matter-what only before backend initialization; afterwards the
+    env edits are no-ops, so we fail loudly if jax already has backends
+    with the wrong shape rather than let callers mis-measure.
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if platform == "cpu" and n_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        xla_flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in xla_flags:
+            xla_flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, xla_flags
+            )
+        else:
+            xla_flags = (xla_flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = xla_flags
+
+    # A site hook may have imported jax already, latching the ambient
+    # platform; updating the live config — not just the env var — makes
+    # backends() initialize only the selected platform (still lazy here).
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+    if n_devices is not None:
+        devices = jax.devices()  # initializes the backend now
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"{platform} backend has {len(devices)} devices, need "
+                f"{n_devices}. If another platform was already initialized "
+                "in this process, re-run in a fresh process."
+            )

@@ -7,20 +7,20 @@ exercised against this mesh; the driver's `dryrun_multichip` does the same.
 
 import os
 
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 # Force CPU: the ambient environment may point JAX_PLATFORMS at a real TPU
 # tunnel (single chip) — tests must not contend with the bench/driver for it,
 # and a leaked device claim would hang backend init indefinitely.
 # Set LODESTAR_TPU_TEST_PLATFORM=axon to run the suite on real hardware.
+from lodestar_tpu.utils.jax_env import force_platform  # noqa: E402
+
 _platform = os.environ.get("LODESTAR_TPU_TEST_PLATFORM", "cpu")
-os.environ["JAX_PLATFORMS"] = _platform
+force_platform(_platform, 8 if _platform == "cpu" else None)
 
-# A site hook may have imported jax at interpreter start, latching the
-# ambient JAX_PLATFORMS (e.g. a tunnel-backed TPU plugin whose lazy client
-# creation blocks on a single-device claim). Updating the live config — not
-# just the env var — makes backends() initialize only the selected platform.
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", _platform)
 
 # Persistent compilation cache: the pairing/verifier kernels are deep
 # (Miller-loop scans + final-exponentiation chains) and take minutes to
@@ -28,11 +28,6 @@ jax.config.update("jax_platforms", _platform)
 _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
 import pytest  # noqa: E402
 
