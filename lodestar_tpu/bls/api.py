@@ -109,10 +109,11 @@ def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
 
 
 class PublicKey:
-    __slots__ = ("point",)
+    __slots__ = ("point", "_compressed")
 
-    def __init__(self, point: PointG1):
+    def __init__(self, point: PointG1, compressed: bytes | None = None):
         self.point = point
+        self._compressed = compressed
 
     @classmethod
     def from_bytes(cls, data: bytes, validate: bool = True) -> "PublicKey":
@@ -123,10 +124,14 @@ class PublicKey:
                 raise BlsError("pubkey is point at infinity")
             if not point.is_in_subgroup():
                 raise BlsError("pubkey not in G1 subgroup")
-        return cls(point)
+        return cls(point, compressed=bytes(data))
 
     def to_bytes(self) -> bytes:
-        return g1_to_bytes(self.point)
+        # cache: the compressed form is the native marshalling tier's input,
+        # so the hot path must not pay a Python affine inversion per use
+        if self._compressed is None:
+            self._compressed = g1_to_bytes(self.point)
+        return self._compressed
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, PublicKey) and self.point == other.point

@@ -33,12 +33,35 @@ def _try_import():
         return False
 
 
+_STAMP = os.path.join(_HERE, "_build_stamp.txt")
+
+
+def _src_digest() -> str:
+    """Content hash of every C source/header (order-independent of mtime)."""
+    import glob
+
+    h = hashlib.sha256()
+    for path in sorted(
+        glob.glob(os.path.join(_HERE, "src", "*.c"))
+        + glob.glob(os.path.join(_HERE, "src", "*.h"))
+    ):
+        h.update(os.path.basename(path).encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
 def _build() -> bool:
-    """Compile the extension in-place with cc (no pip required)."""
+    """Compile the extension in-place with cc (no pip required).
+
+    Writes a content-hash stamp next to the .so on success; the stamp is
+    committed with the .so so fresh checkouts are not misread as stale
+    (file mtimes after `git clone` are meaningless).
+    """
     import sysconfig
 
     src = [os.path.join(_HERE, "src", f) for f in (
-        "module.c", "sha256.c", "xxhash64.c", "snappy_codec.c"
+        "module.c", "sha256.c", "xxhash64.c", "snappy_codec.c", "bls12.c"
     )]
     ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(_HERE, "_lodestar_native" + ext_suffix)
@@ -49,14 +72,71 @@ def _build() -> bool:
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
-        return proc.returncode == 0 and os.path.exists(out)
+        ok = proc.returncode == 0 and os.path.exists(out)
     except (OSError, subprocess.TimeoutExpired):
         return False
+    if ok:
+        try:
+            with open(_STAMP, "w") as f:
+                f.write(_src_digest() + "\n")
+        except OSError:
+            pass
+    return ok
 
 
-if not _try_import():
-    if _build():
-        _try_import()
+def _is_stale() -> bool:
+    """True when the C sources differ from what the extension was built
+    from (content hash vs the build stamp).
+
+    Must be checked BEFORE the first import: CPython cannot reload a C
+    extension in-process, so a stale .so must be rebuilt first.
+    """
+    import sysconfig
+
+    ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    ext = os.path.join(_HERE, "_lodestar_native" + ext_suffix)
+    if not os.path.exists(ext):
+        return True
+    try:
+        with open(_STAMP) as f:
+            stamp = f.read().strip()
+    except OSError:
+        return True  # no stamp: unknown provenance, rebuild to be safe
+    return stamp != _src_digest()
+
+
+def _load() -> None:
+    """Build (at most once) then import the extension.
+
+    The stale check runs BEFORE the first import — CPython cannot reload
+    a C extension in-process, so a stale .so must be rebuilt first. If a
+    rebuild of stale sources fails but an old .so exists, we refuse to
+    import it: silently running pre-edit native code in a consensus
+    client is worse than falling back to the (correct, slow) pure-Python
+    tier, and the warning tells the operator which one they got.
+    """
+    stale = _is_stale()
+    built = _build() if stale else False
+    if stale and not built:
+        import warnings
+
+        warnings.warn(
+            "lodestar_tpu.native: C sources changed (or no extension was "
+            "built) and recompilation failed; using pure-Python fallbacks",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return  # do NOT import a stale .so
+    if not _try_import() and not built:
+        # up-to-date .so failed to load (e.g. built for another platform):
+        # one rebuild attempt, then fall back silently to pure Python
+        if _build():
+            _try_import()
+
+
+_load()
+
+HAVE_NATIVE_BLS = HAVE_NATIVE and hasattr(_mod, "bls_marshal_sets")
 
 
 # --- public API (native or fallback) ---------------------------------------
@@ -100,6 +180,76 @@ def install_ssz_backend() -> None:
     from ..ssz import hashing
 
     hashing.set_hash_backend(sha256_level)
+
+
+# --- native BLS12-381 marshalling tier (bls12.c) -----------------------------
+#
+# Device-limb outputs (int32, 32x12-bit Montgomery — ops/limbs.py layout).
+# No Python fallbacks here: callers check HAVE_NATIVE_BLS and route through
+# the big-int oracle otherwise (parallel/verifier._marshal).
+
+def bls_g1_decompress(data: bytes, check_subgroup: bool = True):
+    """48B compressed G1 → (rc, np (2,32) int32 x/y limbs).
+    rc: 0 ok, 1 infinity, -1 malformed, -2 off-curve, -3 subgroup."""
+    import numpy as np
+
+    rc, buf = _mod.bls_g1_decompress(data, int(check_subgroup))
+    return rc, np.frombuffer(buf, np.int32).reshape(2, 32)
+
+
+def bls_g2_decompress(data: bytes, check_subgroup: bool = True):
+    """96B compressed G2 → (rc, np (2,2,32) int32 x/y limbs)."""
+    import numpy as np
+
+    rc, buf = _mod.bls_g2_decompress(data, int(check_subgroup))
+    return rc, np.frombuffer(buf, np.int32).reshape(2, 2, 32)
+
+
+def bls_hash_to_g2(msg: bytes, dst: bytes):
+    """RFC 9380 hash_to_curve → (rc, np (2,2,32) int32 x/y limbs)."""
+    import numpy as np
+
+    rc, buf = _mod.bls_hash_to_g2(msg, dst)
+    return rc, np.frombuffer(buf, np.int32).reshape(2, 2, 32)
+
+
+def bls_g1_aggregate(pks: bytes, check_each: bool = True):
+    """N×48B pubkeys → (rc, np (2,32) limbs of the affine sum).
+    rc 1 = aggregate is infinity."""
+    import numpy as np
+
+    rc, buf = _mod.bls_g1_aggregate(pks, int(check_each))
+    return rc, np.frombuffer(buf, np.int32).reshape(2, 32)
+
+
+def bls_marshal_sets(pks: bytes, msgs: bytes, sigs: bytes, dst: bytes,
+                     check_pk_subgroup: bool = False,
+                     check_sig_subgroup: bool = True):
+    """Batch-marshal n signature sets straight into device arrays.
+
+    pks n×48B, msgs n×32B signing roots, sigs n×96B →
+    (pk_x (n,32), pk_y (n,32), msg_x (n,2,32), msg_y, sig_x, sig_y, ok (n,) bool)
+
+    Pubkey subgroup checks default OFF: pubkeys reaching the verifier were
+    KeyValidate'd at construction (PublicKey.from_bytes) — re-checking per
+    batch is the hot-path waste the reference also avoids by trusting its
+    pubkey cache (worker.ts deserializes affine without re-checking).
+    Signature subgroup checks default ON (sigFromBytes validates).
+    """
+    import numpy as np
+
+    buf, ok = _mod.bls_marshal_sets(
+        pks, msgs, sigs, dst, int(check_pk_subgroup), int(check_sig_subgroup)
+    )
+    n = len(ok)
+    a = np.frombuffer(buf, np.int32)
+    pk_x = a[: n * 32].reshape(n, 32)
+    pk_y = a[n * 32 : n * 64].reshape(n, 32)
+    msg_x = a[n * 64 : n * 128].reshape(n, 2, 32)
+    msg_y = a[n * 128 : n * 192].reshape(n, 2, 32)
+    sig_x = a[n * 192 : n * 256].reshape(n, 2, 32)
+    sig_y = a[n * 256 : n * 320].reshape(n, 2, 32)
+    return pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, np.frombuffer(ok, np.uint8).astype(bool)
 
 
 # --- pure-Python fallbacks ---------------------------------------------------

@@ -1,0 +1,906 @@
+/* Native BLS12-381 host tier: the marshalling fast path.
+ *
+ * Replaces the pure-Python big-int hot path between wire bytes and the
+ * device verifier (reference analog: blst's in-C preprocessing used by
+ * chain/bls/multithread/worker.ts:33-55 and main-thread aggregation
+ * bls/utils.ts:5-16).  Scope:
+ *
+ *   - G1/G2 point decompression (ZCash flags) + on-curve + subgroup checks
+ *   - SSWU hash-to-curve for G2 (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_)
+ *   - G1 pubkey aggregation
+ *   - batched signature-set marshalling straight into the device's
+ *     32x12-bit Montgomery limb layout (ops/limbs.py)
+ *
+ * Field arithmetic: 6x64-bit limbs, Montgomery form (R = 2^384), CIOS
+ * multiplication with __uint128_t.  All constants are generated from the
+ * Python oracle (gen_bls12_consts.py) so the two tiers cannot disagree.
+ * Scalar multiplications here are variable-time: every input is public
+ * (signatures, pubkeys, message hashes) — no secrets are processed.
+ */
+
+#include <stdint.h>
+#include <string.h>
+#include <stddef.h>
+
+#include "bls12_consts.h"
+
+void lodestar_sha256(const uint8_t *data, size_t len, uint8_t out[32]);
+
+typedef uint64_t fp[6];
+typedef struct { fp c0, c1; } fp2;
+typedef struct { fp X, Y, Z; } g1p;   /* jacobian; Z==0 -> infinity */
+typedef struct { fp2 X, Y, Z; } g2p;
+
+/* ---------------- fp ---------------- */
+
+static void fp_copy(fp r, const fp a) { memcpy(r, a, sizeof(fp)); }
+static void fp_zero(fp r) { memset(r, 0, sizeof(fp)); }
+static int fp_is_zero(const fp a) {
+  return (a[0] | a[1] | a[2] | a[3] | a[4] | a[5]) == 0;
+}
+static int fp_eq(const fp a, const fp b) { return memcmp(a, b, sizeof(fp)) == 0; }
+
+/* a >= b (both < 2^384) */
+static int fp_cmp_ge(const uint64_t *a, const uint64_t *b, int n) {
+  for (int i = n - 1; i >= 0; i--) {
+    if (a[i] > b[i]) return 1;
+    if (a[i] < b[i]) return 0;
+  }
+  return 1;
+}
+
+static void fp_sub_raw(uint64_t *r, const uint64_t *a, const uint64_t *b, int n) {
+  unsigned __int128 borrow = 0;
+  for (int i = 0; i < n; i++) {
+    unsigned __int128 d = (unsigned __int128)a[i] - b[i] - (uint64_t)borrow;
+    r[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+static void fp_add(fp r, const fp a, const fp b) {
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 6; i++) {
+    c += (unsigned __int128)a[i] + b[i];
+    r[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  if (c || fp_cmp_ge(r, BLS_P, 6)) {
+    /* subtract p (carry c can only be 0 here since 2p < 2^384+p... handle both) */
+    uint64_t t[6];
+    fp_sub_raw(t, r, BLS_P, 6);
+    /* if there was a carry out, the subtraction is unconditionally right */
+    fp_copy(r, t);
+  }
+}
+
+static void fp_sub(fp r, const fp a, const fp b) {
+  if (fp_cmp_ge(a, b, 6)) {
+    fp_sub_raw(r, a, b, 6);
+  } else {
+    uint64_t t[6];
+    unsigned __int128 c = 0;
+    for (int i = 0; i < 6; i++) {
+      c += (unsigned __int128)a[i] + BLS_P[i];
+      t[i] = (uint64_t)c;
+      c >>= 64;
+    }
+    fp_sub_raw(r, t, b, 6);
+  }
+}
+
+static void fp_neg(fp r, const fp a) {
+  if (fp_is_zero(a)) { fp_zero(r); return; }
+  fp_sub_raw(r, BLS_P, a, 6);
+}
+
+/* CIOS Montgomery multiplication: r = a*b*R^-1 mod p, result < p. */
+static void fp_mul(fp r, const fp a, const fp b) {
+  uint64_t t[8];
+  memset(t, 0, sizeof(t));
+  for (int i = 0; i < 6; i++) {
+    unsigned __int128 c = 0;
+    for (int j = 0; j < 6; j++) {
+      c += (unsigned __int128)a[j] * b[i] + t[j];
+      t[j] = (uint64_t)c;
+      c >>= 64;
+    }
+    c += t[6];
+    t[6] = (uint64_t)c;
+    t[7] = (uint64_t)(c >> 64);
+
+    uint64_t m = t[0] * BLS_N0;
+    c = (unsigned __int128)m * BLS_P[0] + t[0];
+    c >>= 64;
+    for (int j = 1; j < 6; j++) {
+      c += (unsigned __int128)m * BLS_P[j] + t[j];
+      t[j - 1] = (uint64_t)c;
+      c >>= 64;
+    }
+    c += t[6];
+    t[5] = (uint64_t)c;
+    t[6] = t[7] + (uint64_t)(c >> 64);
+  }
+  if (t[6] || fp_cmp_ge(t, BLS_P, 6)) fp_sub_raw(t, t, BLS_P, 6);
+  memcpy(r, t, sizeof(fp));
+}
+
+static void fp_sqr(fp r, const fp a) { fp_mul(r, a, a); }
+
+/* a^e for little-endian word exponent (variable time; public data only). */
+static void fp_exp(fp r, const fp a, const uint64_t *e, int words) {
+  fp acc;
+  fp_copy(acc, BLS_ONE_M);
+  int started = 0;
+  for (int w = words - 1; w >= 0; w--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) fp_sqr(acc, acc);
+      if ((e[w] >> b) & 1) {
+        if (started) fp_mul(acc, acc, a);
+        else { fp_copy(acc, a); started = 1; }
+      }
+    }
+  }
+  fp_copy(r, acc);
+}
+
+static void fp_inv(fp r, const fp a) { fp_exp(r, a, BLS_EXP_INV, 6); }
+
+/* sqrt (p = 3 mod 4): cand = a^((p+1)/4); returns 0 if a is not a QR. */
+static int fp_sqrt(fp r, const fp a) {
+  fp cand, chk;
+  fp_exp(cand, a, BLS_EXP_SQRT, 6);
+  fp_sqr(chk, cand);
+  if (!fp_eq(chk, a)) return 0;
+  fp_copy(r, cand);
+  return 1;
+}
+
+/* Montgomery -> canonical integer (little-endian words). */
+static void fp_from_mont(uint64_t out[6], const fp a) {
+  fp one = {1, 0, 0, 0, 0, 0};
+  fp_mul((uint64_t *)out, a, one);
+}
+
+static void fp_to_mont(fp r, const uint64_t in[6]) { fp_mul(r, in, BLS_R2); }
+
+static int fp_sgn0(const fp a) {
+  uint64_t c[6];
+  fp_from_mont(c, a);
+  return (int)(c[0] & 1);
+}
+
+static int fp_lex_larger(const fp a) {
+  uint64_t c[6];
+  fp_from_mont(c, a);
+  /* canonical > (p-1)/2 */
+  for (int i = 5; i >= 0; i--) {
+    if (c[i] > BLS_HALF_P[i]) return 1;
+    if (c[i] < BLS_HALF_P[i]) return 0;
+  }
+  return 0; /* equal -> not larger */
+}
+
+/* 48 big-endian bytes -> canonical words; returns 0 if >= p. */
+static int fp_from_be(uint64_t out[6], const uint8_t in[48]) {
+  for (int i = 0; i < 6; i++) {
+    uint64_t w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[(5 - i) * 8 + j];
+    out[i] = w;
+  }
+  return !fp_cmp_ge(out, BLS_P, 6);
+}
+
+/* Montgomery fp -> 32x12-bit int32 device limbs (value = a*R mod p). */
+static void fp_to_limbs12(int32_t out[32], const fp a) {
+  /* the Montgomery residue itself is what the device stores */
+  const uint64_t *w = a;
+  for (int i = 0; i < 32; i++) {
+    int bit = i * 12;
+    int word = bit >> 6, off = bit & 63;
+    uint64_t v = w[word] >> off;
+    if (off > 52 && word < 5) v |= w[word + 1] << (64 - off);
+    out[i] = (int32_t)(v & 0xFFF);
+  }
+}
+
+/* ---------------- fp2 ---------------- */
+
+static void fp2_copy(fp2 *r, const fp2 *a) { *r = *a; }
+static void fp2_zero(fp2 *r) { fp_zero(r->c0); fp_zero(r->c1); }
+static int fp2_is_zero(const fp2 *a) { return fp_is_zero(a->c0) && fp_is_zero(a->c1); }
+static int fp2_eq(const fp2 *a, const fp2 *b) {
+  return fp_eq(a->c0, b->c0) && fp_eq(a->c1, b->c1);
+}
+static void fp2_one(fp2 *r) { fp_copy(r->c0, BLS_ONE_M); fp_zero(r->c1); }
+
+static void fp2_add(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp_add(r->c0, a->c0, b->c0);
+  fp_add(r->c1, a->c1, b->c1);
+}
+static void fp2_sub(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp_sub(r->c0, a->c0, b->c0);
+  fp_sub(r->c1, a->c1, b->c1);
+}
+static void fp2_neg(fp2 *r, const fp2 *a) {
+  fp_neg(r->c0, a->c0);
+  fp_neg(r->c1, a->c1);
+}
+static void fp2_conj(fp2 *r, const fp2 *a) {
+  fp_copy(r->c0, a->c0);
+  fp_neg(r->c1, a->c1);
+}
+
+static void fp2_mul(fp2 *r, const fp2 *a, const fp2 *b) {
+  fp t0, t1, t2, t3, s0, s1;
+  fp_mul(t0, a->c0, b->c0);
+  fp_mul(t1, a->c1, b->c1);
+  fp_add(t2, a->c0, a->c1);
+  fp_add(t3, b->c0, b->c1);
+  fp_mul(t2, t2, t3);          /* (a0+a1)(b0+b1) */
+  fp_sub(s0, t0, t1);          /* c0 = a0b0 - a1b1 */
+  fp_sub(t2, t2, t0);
+  fp_sub(s1, t2, t1);          /* c1 = cross */
+  fp_copy(r->c0, s0);
+  fp_copy(r->c1, s1);
+}
+
+static void fp2_sqr(fp2 *r, const fp2 *a) {
+  fp t0, t1, s0;
+  fp_add(t0, a->c0, a->c1);
+  fp_sub(t1, a->c0, a->c1);
+  fp_mul(s0, t0, t1);          /* (a0+a1)(a0-a1) */
+  fp_mul(t0, a->c0, a->c1);
+  fp_copy(r->c0, s0);
+  fp_add(r->c1, t0, t0);       /* 2 a0 a1 */
+}
+
+static void fp2_mul_fp(fp2 *r, const fp2 *a, const fp k) {
+  fp_mul(r->c0, a->c0, k);
+  fp_mul(r->c1, a->c1, k);
+}
+
+static void fp2_inv(fp2 *r, const fp2 *a) {
+  fp n, n0, n1;
+  fp_sqr(n0, a->c0);
+  fp_sqr(n1, a->c1);
+  fp_add(n, n0, n1);
+  fp_inv(n, n);
+  fp_mul(r->c0, a->c0, n);
+  fp_mul(n, a->c1, n);
+  fp_neg(r->c1, n);
+}
+
+static void fp2_exp(fp2 *r, const fp2 *a, const uint64_t *e, int words) {
+  fp2 acc;
+  fp2_one(&acc);
+  int started = 0;
+  for (int w = words - 1; w >= 0; w--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) fp2_sqr(&acc, &acc);
+      if ((e[w] >> b) & 1) {
+        if (started) fp2_mul(&acc, &acc, a);
+        else { fp2_copy(&acc, a); started = 1; }
+      }
+    }
+  }
+  fp2_copy(r, &acc);
+}
+
+/* Fq2 sqrt: cand = a^((p^2+7)/16) corrected by {1, i, w, iw}. 0 = not a QR. */
+static int fp2_sqrt(fp2 *r, const fp2 *a) {
+  if (fp2_is_zero(a)) { fp2_zero(r); return 1; }
+  fp2 cand, s, chk;
+  fp2_exp(&cand, a, BLS_EXP_SQRT_FQ2, 12);
+  for (int i = 0; i < 4; i++) {
+    fp2 corr;
+    fp_copy(corr.c0, BLS_SQRT_CORR[i][0]);
+    fp_copy(corr.c1, BLS_SQRT_CORR[i][1]);
+    fp2_mul(&s, &cand, &corr);
+    fp2_sqr(&chk, &s);
+    if (fp2_eq(&chk, a)) { fp2_copy(r, &s); return 1; }
+  }
+  return 0;
+}
+
+static int fp2_sgn0(const fp2 *a) {
+  /* RFC 9380 sgn0, m=2 */
+  uint64_t c0[6];
+  fp_from_mont(c0, a->c0);
+  int sign_0 = (int)(c0[0] & 1);
+  int zero_0 = 1;
+  for (int i = 0; i < 6; i++) zero_0 &= (c0[i] == 0);
+  int sign_1 = fp_sgn0(a->c1);
+  return sign_0 | (zero_0 & sign_1);
+}
+
+static int fp2_lex_larger(const fp2 *y) {
+  /* ZCash convention: compare (c1, c0) lexicographically with (p-1)/2 */
+  if (!fp_is_zero(y->c1)) return fp_lex_larger(y->c1);
+  return fp_lex_larger(y->c0);
+}
+
+/* ---------------- G1 (jacobian) ---------------- */
+
+static void g1_infinity(g1p *r) {
+  fp_copy(r->X, BLS_ONE_M);
+  fp_copy(r->Y, BLS_ONE_M);
+  fp_zero(r->Z);
+}
+static int g1_is_infinity(const g1p *p) { return fp_is_zero(p->Z); }
+
+static void g1_dbl(g1p *r, const g1p *p) {
+  if (g1_is_infinity(p)) { *r = *p; return; }
+  fp A, B, C, D, E, F, t;
+  fp_sqr(A, p->X);
+  fp_sqr(B, p->Y);
+  fp_sqr(C, B);
+  fp_add(t, p->X, B);
+  fp_sqr(t, t);
+  fp_sub(t, t, A);
+  fp_sub(t, t, C);
+  fp_add(D, t, t);            /* 2((X+B)^2 - A - C) */
+  fp_add(E, A, A);
+  fp_add(E, E, A);            /* 3A */
+  fp_sqr(F, E);
+  fp t2;
+  fp_add(t2, D, D);
+  fp_sub(F, F, t2);           /* X3 = F - 2D */
+  fp Y3;
+  fp_sub(Y3, D, F);
+  fp_mul(Y3, E, Y3);
+  fp C8;
+  fp_add(C8, C, C);
+  fp_add(C8, C8, C8);
+  fp_add(C8, C8, C8);         /* 8C */
+  fp_sub(Y3, Y3, C8);
+  fp Z3;
+  fp_mul(Z3, p->Y, p->Z);
+  fp_add(Z3, Z3, Z3);
+  fp_copy(r->X, F);
+  fp_copy(r->Y, Y3);
+  fp_copy(r->Z, Z3);
+}
+
+static void g1_add(g1p *r, const g1p *p, const g1p *q) {
+  if (g1_is_infinity(p)) { *r = *q; return; }
+  if (g1_is_infinity(q)) { *r = *p; return; }
+  fp Z1Z1, Z2Z2, U1, U2, S1, S2, H, rr, t;
+  fp_sqr(Z1Z1, p->Z);
+  fp_sqr(Z2Z2, q->Z);
+  fp_mul(U1, p->X, Z2Z2);
+  fp_mul(U2, q->X, Z1Z1);
+  fp_mul(t, q->Z, Z2Z2);
+  fp_mul(S1, p->Y, t);
+  fp_mul(t, p->Z, Z1Z1);
+  fp_mul(S2, q->Y, t);
+  fp_sub(H, U2, U1);
+  fp_sub(rr, S2, S1);
+  if (fp_is_zero(H)) {
+    if (fp_is_zero(rr)) { g1_dbl(r, p); return; }
+    g1_infinity(r);
+    return;
+  }
+  fp I, J, r2, V, X3, Y3, Z3;
+  fp_add(t, H, H);
+  fp_sqr(I, t);               /* (2H)^2 */
+  fp_mul(J, H, I);
+  fp_add(r2, rr, rr);
+  fp_mul(V, U1, I);
+  fp_sqr(X3, r2);
+  fp_sub(X3, X3, J);
+  fp_sub(X3, X3, V);
+  fp_sub(X3, X3, V);
+  fp_sub(Y3, V, X3);
+  fp_mul(Y3, r2, Y3);
+  fp_mul(t, S1, J);
+  fp_add(t, t, t);
+  fp_sub(Y3, Y3, t);
+  fp_add(Z3, p->Z, q->Z);
+  fp_sqr(Z3, Z3);
+  fp_sub(Z3, Z3, Z1Z1);
+  fp_sub(Z3, Z3, Z2Z2);
+  fp_mul(Z3, Z3, H);
+  fp_copy(r->X, X3);
+  fp_copy(r->Y, Y3);
+  fp_copy(r->Z, Z3);
+}
+
+static void g1_scalar_mul(g1p *r, const g1p *p, const uint64_t *k, int words) {
+  g1p acc;
+  g1_infinity(&acc);
+  int started = 0;
+  for (int w = words - 1; w >= 0; w--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) g1_dbl(&acc, &acc);
+      if ((k[w] >> b) & 1) {
+        if (started) g1_add(&acc, &acc, p);
+        else { acc = *p; started = 1; }
+      }
+    }
+  }
+  if (!started) g1_infinity(&acc);
+  *r = acc;
+}
+
+static int g1_in_subgroup(const g1p *p) {
+  g1p t;
+  g1_scalar_mul(&t, p, BLS_ORDER_R, 4);
+  return g1_is_infinity(&t);
+}
+
+static void g1_to_affine(fp x, fp y, const g1p *p) {
+  fp zi, zi2;
+  fp_inv(zi, p->Z);
+  fp_sqr(zi2, zi);
+  fp_mul(x, p->X, zi2);
+  fp_mul(zi2, zi2, zi);
+  fp_mul(y, p->Y, zi2);
+}
+
+/* ---------------- G2 (jacobian over fp2) ---------------- */
+
+static void g2_infinity(g2p *r) {
+  fp2_one(&r->X);
+  fp2_one(&r->Y);
+  fp2_zero(&r->Z);
+}
+static int g2_is_infinity(const g2p *p) { return fp2_is_zero(&p->Z); }
+
+static void g2_dbl(g2p *r, const g2p *p) {
+  if (g2_is_infinity(p)) { *r = *p; return; }
+  fp2 A, B, C, D, E, F, t, t2, Y3, Z3, C8;
+  fp2_sqr(&A, &p->X);
+  fp2_sqr(&B, &p->Y);
+  fp2_sqr(&C, &B);
+  fp2_add(&t, &p->X, &B);
+  fp2_sqr(&t, &t);
+  fp2_sub(&t, &t, &A);
+  fp2_sub(&t, &t, &C);
+  fp2_add(&D, &t, &t);
+  fp2_add(&E, &A, &A);
+  fp2_add(&E, &E, &A);
+  fp2_sqr(&F, &E);
+  fp2_add(&t2, &D, &D);
+  fp2_sub(&F, &F, &t2);
+  fp2_sub(&Y3, &D, &F);
+  fp2_mul(&Y3, &E, &Y3);
+  fp2_add(&C8, &C, &C);
+  fp2_add(&C8, &C8, &C8);
+  fp2_add(&C8, &C8, &C8);
+  fp2_sub(&Y3, &Y3, &C8);
+  fp2_mul(&Z3, &p->Y, &p->Z);
+  fp2_add(&Z3, &Z3, &Z3);
+  fp2_copy(&r->X, &F);
+  fp2_copy(&r->Y, &Y3);
+  fp2_copy(&r->Z, &Z3);
+}
+
+static void g2_add(g2p *r, const g2p *p, const g2p *q) {
+  if (g2_is_infinity(p)) { *r = *q; return; }
+  if (g2_is_infinity(q)) { *r = *p; return; }
+  fp2 Z1Z1, Z2Z2, U1, U2, S1, S2, H, rr, t, I, J, r2, V, X3, Y3, Z3;
+  fp2_sqr(&Z1Z1, &p->Z);
+  fp2_sqr(&Z2Z2, &q->Z);
+  fp2_mul(&U1, &p->X, &Z2Z2);
+  fp2_mul(&U2, &q->X, &Z1Z1);
+  fp2_mul(&t, &q->Z, &Z2Z2);
+  fp2_mul(&S1, &p->Y, &t);
+  fp2_mul(&t, &p->Z, &Z1Z1);
+  fp2_mul(&S2, &q->Y, &t);
+  fp2_sub(&H, &U2, &U1);
+  fp2_sub(&rr, &S2, &S1);
+  if (fp2_is_zero(&H)) {
+    if (fp2_is_zero(&rr)) { g2_dbl(r, p); return; }
+    g2_infinity(r);
+    return;
+  }
+  fp2_add(&t, &H, &H);
+  fp2_sqr(&I, &t);
+  fp2_mul(&J, &H, &I);
+  fp2_add(&r2, &rr, &rr);
+  fp2_mul(&V, &U1, &I);
+  fp2_sqr(&X3, &r2);
+  fp2_sub(&X3, &X3, &J);
+  fp2_sub(&X3, &X3, &V);
+  fp2_sub(&X3, &X3, &V);
+  fp2_sub(&Y3, &V, &X3);
+  fp2_mul(&Y3, &r2, &Y3);
+  fp2_mul(&t, &S1, &J);
+  fp2_add(&t, &t, &t);
+  fp2_sub(&Y3, &Y3, &t);
+  fp2_add(&Z3, &p->Z, &q->Z);
+  fp2_sqr(&Z3, &Z3);
+  fp2_sub(&Z3, &Z3, &Z1Z1);
+  fp2_sub(&Z3, &Z3, &Z2Z2);
+  fp2_mul(&Z3, &Z3, &H);
+  fp2_copy(&r->X, &X3);
+  fp2_copy(&r->Y, &Y3);
+  fp2_copy(&r->Z, &Z3);
+}
+
+static void g2_neg(g2p *r, const g2p *p) {
+  *r = *p;
+  fp2_neg(&r->Y, &p->Y);
+}
+
+static void g2_scalar_mul(g2p *r, const g2p *p, const uint64_t *k, int words) {
+  g2p acc;
+  g2_infinity(&acc);
+  int started = 0;
+  for (int w = words - 1; w >= 0; w--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) g2_dbl(&acc, &acc);
+      if ((k[w] >> b) & 1) {
+        if (started) g2_add(&acc, &acc, p);
+        else { acc = *p; started = 1; }
+      }
+    }
+  }
+  if (!started) g2_infinity(&acc);
+  *r = acc;
+}
+
+static int g2_in_subgroup(const g2p *p) {
+  g2p t;
+  g2_scalar_mul(&t, p, BLS_ORDER_R, 4);
+  return g2_is_infinity(&t);
+}
+
+static void g2_to_affine(fp2 *x, fp2 *y, const g2p *p) {
+  fp2 zi, zi2;
+  fp2_inv(&zi, &p->Z);
+  fp2_sqr(&zi2, &zi);
+  fp2_mul(x, &p->X, &zi2);
+  fp2_mul(&zi2, &zi2, &zi);
+  fp2_mul(y, &p->Y, &zi2);
+}
+
+/* psi endomorphism on jacobian coords: conjugate everything, then scale
+ * X by CX and Y by CY (valid because conj(X/Z^2) = conj(X)/conj(Z)^2). */
+static void g2_psi(g2p *r, const g2p *p) {
+  fp2 cx, cy;
+  memcpy(&cx, BLS_PSI_CX, sizeof(fp2));
+  memcpy(&cy, BLS_PSI_CY, sizeof(fp2));
+  fp2 X, Y, Z;
+  fp2_conj(&X, &p->X);
+  fp2_conj(&Y, &p->Y);
+  fp2_conj(&Z, &p->Z);
+  fp2_mul(&r->X, &X, &cx);
+  fp2_mul(&r->Y, &Y, &cy);
+  fp2_copy(&r->Z, &Z);
+}
+
+/* Budroni-Pintore: h_eff.P = [S1]P + [S2]psi(P) + psi^2(2P), S2 < 0. */
+static void g2_clear_cofactor(g2p *r, const g2p *p) {
+  g2p t1, t2, t3, psi_p;
+  g2_scalar_mul(&t1, p, BLS_BP_S1, 3);
+  g2_psi(&psi_p, p);
+  g2_scalar_mul(&t2, &psi_p, BLS_BP_S2_ABS, 2);
+  g2_neg(&t2, &t2);
+  g2_dbl(&t3, p);
+  g2_psi(&t3, &t3);
+  g2_psi(&t3, &t3);
+  g2_add(&t1, &t1, &t2);
+  g2_add(r, &t1, &t3);
+}
+
+/* ---------------- serialization ---------------- */
+
+#define FLAG_C 0x80
+#define FLAG_I 0x40
+#define FLAG_S 0x20
+
+/* Parse a 48B compressed G1 point into affine-Z=1 montgomery coords.
+ * Returns 0 ok / 1 infinity / -1 malformed / -2 not on curve.  The ZCash
+ * flag rules: C must be set; I implies all other payload bits zero.  No
+ * subgroup check here — callers decide (single shared implementation for
+ * decompress + aggregate, so validation policy lives in one place). */
+static int g1_parse_compressed(const uint8_t in[48], g1p *out) {
+  uint8_t flags = in[0];
+  if (!(flags & FLAG_C)) return -1;
+  if (flags & FLAG_I) {
+    if (flags != (FLAG_C | FLAG_I)) return -1;
+    for (int i = 1; i < 48; i++)
+      if (in[i]) return -1;
+    g1_infinity(out);
+    return 1;
+  }
+  uint8_t buf[48];
+  memcpy(buf, in, 48);
+  buf[0] &= 0x1F;
+  uint64_t xw[6];
+  if (!fp_from_be(xw, buf)) return -1;
+  fp x, y, y2, t;
+  fp_to_mont(x, xw);
+  fp_sqr(t, x);
+  fp_mul(t, t, x);
+  fp_add(y2, t, BLS_B1_M);
+  if (!fp_sqrt(y, y2)) return -2;
+  if (fp_lex_larger(y) != !!(flags & FLAG_S)) fp_neg(y, y);
+  fp_copy(out->X, x);
+  fp_copy(out->Y, y);
+  fp_copy(out->Z, BLS_ONE_M);
+  return 0;
+}
+
+/* returns 0 ok / 1 infinity / -1 malformed / -2 not on curve /
+ * -3 not in subgroup.  out_x/out_y are 32 int32 device limbs. */
+int lodestar_bls_g1_decompress(const uint8_t in[48], int32_t out_x[32],
+                               int32_t out_y[32], int check_subgroup) {
+  memset(out_x, 0, 32 * sizeof(int32_t));
+  memset(out_y, 0, 32 * sizeof(int32_t));
+  g1p p;
+  int rc = g1_parse_compressed(in, &p);
+  if (rc != 0) return rc;
+  if (check_subgroup && !g1_in_subgroup(&p)) return -3;
+  fp_to_limbs12(out_x, p.X);
+  fp_to_limbs12(out_y, p.Y);
+  return 0;
+}
+
+int lodestar_bls_g2_decompress(const uint8_t in[96], int32_t out_x[64],
+                               int32_t out_y[64], int check_subgroup) {
+  memset(out_x, 0, 64 * sizeof(int32_t));
+  memset(out_y, 0, 64 * sizeof(int32_t));
+  uint8_t flags = in[0];
+  if (!(flags & FLAG_C)) return -1;
+  if (flags & FLAG_I) {
+    if (flags != (FLAG_C | FLAG_I)) return -1;
+    for (int i = 1; i < 96; i++)
+      if (in[i]) return -1;
+    return 1;
+  }
+  uint8_t buf[48];
+  memcpy(buf, in, 48);
+  buf[0] &= 0x1F;
+  uint64_t x1w[6], x0w[6];
+  if (!fp_from_be(x1w, buf)) return -1;       /* first 48B: c1 (ZCash order) */
+  if (!fp_from_be(x0w, in + 48)) return -1;   /* second 48B: c0 */
+  fp2 x, y, y2, t;
+  fp_to_mont(x.c0, x0w);
+  fp_to_mont(x.c1, x1w);
+  fp2_sqr(&t, &x);
+  fp2_mul(&t, &t, &x);
+  fp2 b2;
+  memcpy(&b2, BLS_B2_M, sizeof(fp2));
+  fp2_add(&y2, &t, &b2);
+  if (!fp2_sqrt(&y, &y2)) return -2;
+  if (fp2_lex_larger(&y) != !!(flags & FLAG_S)) fp2_neg(&y, &y);
+  if (check_subgroup) {
+    g2p p;
+    fp2_copy(&p.X, &x);
+    fp2_copy(&p.Y, &y);
+    fp2_one(&p.Z);
+    if (!g2_in_subgroup(&p)) return -3;
+  }
+  fp_to_limbs12(out_x, x.c0);
+  fp_to_limbs12(out_x + 32, x.c1);
+  fp_to_limbs12(out_y, y.c0);
+  fp_to_limbs12(out_y + 32, y.c1);
+  return 0;
+}
+
+/* ---------------- hash to curve (G2) ---------------- */
+
+/* RFC 9380 5.3.1 expand_message_xmd, SHA-256, len fixed to 256 bytes
+ * (count=2 draws x m=2 coords x L=64). msg arbitrary length. */
+static void expand_message_xmd_256(const uint8_t *msg, size_t msg_len,
+                                   const uint8_t *dst, size_t dst_len,
+                                   uint8_t out[256]) {
+  uint8_t dst_prime[256];
+  size_t dpl = dst_len;
+  memcpy(dst_prime, dst, dst_len);
+  dst_prime[dpl++] = (uint8_t)dst_len;
+
+  uint8_t b0[32], bi[32];
+  /* b0 = H(Z_pad || msg || l_i_b_str || 0 || dst'); one-shot SHA over a
+   * stack buffer — callers cap msg at 3KB (consensus messages are 32B). */
+  {
+    uint8_t big[4096];
+    size_t off = 0;
+    memset(big, 0, 64);
+    off = 64;
+    memcpy(big + off, msg, msg_len);
+    off += msg_len;
+    big[off++] = 1; /* l_i_b_str hi: 256 = 0x0100 */
+    big[off++] = 0;
+    big[off++] = 0;
+    memcpy(big + off, dst_prime, dpl);
+    off += dpl;
+    lodestar_sha256(big, off, b0);
+  }
+  uint8_t cur[32 + 1 + 256];
+  memcpy(cur, b0, 32);
+  cur[32] = 1;
+  memcpy(cur + 33, dst_prime, dpl);
+  lodestar_sha256(cur, 33 + dpl, bi);
+  memcpy(out, bi, 32);
+  for (int i = 2; i <= 8; i++) {
+    for (int j = 0; j < 32; j++) cur[j] = b0[j] ^ bi[j];
+    cur[32] = (uint8_t)i;
+    memcpy(cur + 33, dst_prime, dpl);
+    lodestar_sha256(cur, 33 + dpl, bi);
+    memcpy(out + (i - 1) * 32, bi, 32);
+  }
+}
+
+/* 64 big-endian bytes -> field element (Montgomery), reduced mod p. */
+static void fp_from_be64_mod(fp r, const uint8_t in[64]) {
+  /* value = a1*2^384 + a0, a1 = top 16 bytes, a0 = bottom 48 bytes */
+  uint64_t a1[6] = {0}, a0[6];
+  for (int i = 0; i < 2; i++) {
+    uint64_t w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[(1 - i) * 8 + j];
+    a1[i] = w;
+  }
+  for (int i = 0; i < 6; i++) {
+    uint64_t w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[16 + (5 - i) * 8 + j];
+    a0[i] = w;
+  }
+  fp m1, m0;
+  fp_mul(m1, a1, BLS_R2);       /* a1 * R  (valid: a1 < R) */
+  fp_mul(m1, m1, BLS_R2);       /* a1 * R * R = (a1*2^384)*R mod p */
+  fp_mul(m0, a0, BLS_R2);       /* a0 * R */
+  fp_add(r, m1, m0);
+}
+
+/* simplified SWU onto E2' (RFC 9380 6.6.2), then 3-isogeny to E2. */
+static void map_to_curve_g2(g2p *out, const fp2 *u) {
+  fp2 A, B, Z, nba, bza;
+  memcpy(&A, BLS_SSWU_A, sizeof(fp2));
+  memcpy(&B, BLS_SSWU_B, sizeof(fp2));
+  memcpy(&Z, BLS_SSWU_Z, sizeof(fp2));
+  memcpy(&nba, BLS_SSWU_NBA, sizeof(fp2));
+  memcpy(&bza, BLS_SSWU_BZA, sizeof(fp2));
+
+  fp2 u2, zu2, tv, x1, gx1, y, x, one;
+  fp2_sqr(&u2, u);
+  fp2_mul(&zu2, &Z, &u2);
+  fp2_sqr(&tv, &zu2);
+  fp2_add(&tv, &tv, &zu2);            /* Z^2 u^4 + Z u^2 */
+  if (fp2_is_zero(&tv)) {
+    fp2_copy(&x1, &bza);              /* B/(Z*A) */
+  } else {
+    fp2 ti;
+    fp2_inv(&ti, &tv);
+    fp2_one(&one);
+    fp2_add(&ti, &ti, &one);
+    fp2_mul(&x1, &nba, &ti);          /* -B/A * (1 + 1/tv) */
+  }
+  fp2 t;
+  fp2_sqr(&t, &x1);
+  fp2_mul(&t, &t, &x1);
+  fp2 ax;
+  fp2_mul(&ax, &A, &x1);
+  fp2_add(&t, &t, &ax);
+  fp2_add(&gx1, &t, &B);
+  if (fp2_sqrt(&y, &gx1)) {
+    fp2_copy(&x, &x1);
+  } else {
+    fp2 x2, gx2;
+    fp2_mul(&x2, &zu2, &x1);
+    fp2_sqr(&t, &x2);
+    fp2_mul(&t, &t, &x2);
+    fp2_mul(&ax, &A, &x2);
+    fp2_add(&t, &t, &ax);
+    fp2_add(&gx2, &t, &B);
+    fp2_sqrt(&y, &gx2);               /* must succeed */
+    fp2_copy(&x, &x2);
+  }
+  if (fp2_sgn0(&y) != fp2_sgn0(u)) fp2_neg(&y, &y);
+
+  /* 3-isogeny (Velu form): X(x) = x + t/(x-x0) + u/(x-x0)^2, then the
+   * scaling isomorphism (x,y) -> (x/l^2, y/l^3). */
+  fp2 x0c, tc, uc, d, di, di2, di3, xx, dx, two_u, yy;
+  memcpy(&x0c, BLS_ISO_X0, sizeof(fp2));
+  memcpy(&tc, BLS_ISO_T, sizeof(fp2));
+  memcpy(&uc, BLS_ISO_U, sizeof(fp2));
+  fp2_sub(&d, &x, &x0c);
+  fp2_inv(&di, &d);
+  fp2_sqr(&di2, &di);
+  fp2_mul(&di3, &di2, &di);
+  fp2 term;
+  fp2_mul(&term, &tc, &di);
+  fp2_add(&xx, &x, &term);
+  fp2_mul(&term, &uc, &di2);
+  fp2_add(&xx, &xx, &term);
+  fp2_one(&one);
+  fp2_mul(&term, &tc, &di2);
+  fp2_sub(&dx, &one, &term);
+  fp2_add(&two_u, &uc, &uc);
+  fp2_mul(&term, &two_u, &di3);
+  fp2_sub(&dx, &dx, &term);
+  fp2_mul(&yy, &y, &dx);
+  fp2_mul_fp(&xx, &xx, BLS_ISO_IL2);
+  fp2_mul_fp(&yy, &yy, BLS_ISO_IL3);
+
+  fp2_copy(&out->X, &xx);
+  fp2_copy(&out->Y, &yy);
+  fp2_one(&out->Z);
+}
+
+int lodestar_bls_hash_to_g2(const uint8_t *msg, size_t msg_len,
+                            const uint8_t *dst, size_t dst_len,
+                            int32_t out_x[64], int32_t out_y[64]) {
+  if (msg_len > 3000 || dst_len == 0 || dst_len > 255) return -1;
+  uint8_t uniform[256];
+  expand_message_xmd_256(msg, msg_len, dst, dst_len, uniform);
+  fp2 u0, u1;
+  fp_from_be64_mod(u0.c0, uniform);
+  fp_from_be64_mod(u0.c1, uniform + 64);
+  fp_from_be64_mod(u1.c0, uniform + 128);
+  fp_from_be64_mod(u1.c1, uniform + 192);
+  g2p q0, q1, q;
+  map_to_curve_g2(&q0, &u0);
+  map_to_curve_g2(&q1, &u1);
+  g2_add(&q, &q0, &q1);
+  g2_clear_cofactor(&q, &q);
+  if (g2_is_infinity(&q)) return -2;  /* astronomically unlikely */
+  fp2 x, y;
+  g2_to_affine(&x, &y, &q);
+  fp_to_limbs12(out_x, x.c0);
+  fp_to_limbs12(out_x + 32, x.c1);
+  fp_to_limbs12(out_y, y.c0);
+  fp_to_limbs12(out_y + 32, y.c1);
+  return 0;
+}
+
+/* ---------------- aggregation ---------------- */
+
+/* Aggregate n compressed G1 pubkeys -> device limbs of the affine sum.
+ * Returns 0 ok / 1 aggregate-is-infinity / -1 malformed / -2 off-curve /
+ * -3 subgroup.  Infinity pubkeys contribute nothing (callers reject them
+ * upstream at KeyValidate). */
+int lodestar_bls_g1_aggregate(const uint8_t *pks, size_t n, int check_each,
+                              int32_t out_x[32], int32_t out_y[32]) {
+  memset(out_x, 0, 32 * sizeof(int32_t));
+  memset(out_y, 0, 32 * sizeof(int32_t));
+  g1p acc;
+  g1_infinity(&acc);
+  for (size_t i = 0; i < n; i++) {
+    g1p p;
+    int rc = g1_parse_compressed(pks + 48 * i, &p);
+    if (rc == 1) continue;
+    if (rc != 0) return rc;
+    if (check_each && !g1_in_subgroup(&p)) return -3;
+    g1_add(&acc, &acc, &p);
+  }
+  if (g1_is_infinity(&acc)) return 1;
+  fp x, y;
+  g1_to_affine(x, y, &acc);
+  fp_to_limbs12(out_x, x);
+  fp_to_limbs12(out_y, y);
+  return 0;
+}
+
+/* ---------------- batched set marshalling ----------------
+ *
+ * For n signature sets (pubkey 48B, message 32B signing root, signature
+ * 96B) fill the device arrays pk_x/pk_y (n,32), msg_x/msg_y/sig_x/sig_y
+ * (n,64) and ok (n bytes).  A set that fails decompression/subgroup or has
+ * an infinity pubkey/signature gets ok=0 and zeroed lanes (the reference
+ * rejects those sets: maybeBatch.ts catching blst errors).
+ */
+int lodestar_bls_marshal_sets(size_t n, const uint8_t *pks, const uint8_t *msgs,
+                              const uint8_t *sigs, const uint8_t *dst,
+                              size_t dst_len, int check_pk_subgroup,
+                              int check_sig_subgroup, int32_t *pk_x,
+                              int32_t *pk_y, int32_t *msg_x, int32_t *msg_y,
+                              int32_t *sig_x, int32_t *sig_y, uint8_t *ok) {
+  for (size_t i = 0; i < n; i++) {
+    ok[i] = 0;
+    int rc = lodestar_bls_g1_decompress(pks + 48 * i, pk_x + 32 * i,
+                                        pk_y + 32 * i, check_pk_subgroup);
+    if (rc != 0) continue; /* infinity pubkey is invalid per Eth2 */
+    rc = lodestar_bls_g2_decompress(sigs + 96 * i, sig_x + 64 * i,
+                                    sig_y + 64 * i, check_sig_subgroup);
+    if (rc != 0) continue;
+    rc = lodestar_bls_hash_to_g2(msgs + 32 * i, 32, dst, dst_len,
+                                 msg_x + 64 * i, msg_y + 64 * i);
+    if (rc != 0) continue;
+    ok[i] = 1;
+  }
+  return 0;
+}

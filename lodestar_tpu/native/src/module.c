@@ -130,6 +130,150 @@ static PyObject *py_snappy_uncompress(PyObject *self, PyObject *args) {
   return out;
 }
 
+/* ---- BLS12-381 host tier (bls12.c) ---- */
+
+int lodestar_bls_g1_decompress(const uint8_t in[48], int32_t out_x[32],
+                               int32_t out_y[32], int check_subgroup);
+int lodestar_bls_g2_decompress(const uint8_t in[96], int32_t out_x[64],
+                               int32_t out_y[64], int check_subgroup);
+int lodestar_bls_hash_to_g2(const uint8_t *msg, size_t msg_len,
+                            const uint8_t *dst, size_t dst_len,
+                            int32_t out_x[64], int32_t out_y[64]);
+int lodestar_bls_g1_aggregate(const uint8_t *pks, size_t n, int check_each,
+                              int32_t out_x[32], int32_t out_y[32]);
+int lodestar_bls_marshal_sets(size_t n, const uint8_t *pks, const uint8_t *msgs,
+                              const uint8_t *sigs, const uint8_t *dst,
+                              size_t dst_len, int check_pk_subgroup,
+                              int check_sig_subgroup, int32_t *pk_x,
+                              int32_t *pk_y, int32_t *msg_x, int32_t *msg_y,
+                              int32_t *sig_x, int32_t *sig_y, uint8_t *ok);
+
+static PyObject *py_bls_g1_decompress(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  int check = 1, rc;
+  if (!PyArg_ParseTuple(args, "y*|i", &buf, &check)) return NULL;
+  if (buf.len != 48) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "G1 compressed point must be 48 bytes");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, 64 * 4);
+  if (!out) { PyBuffer_Release(&buf); return NULL; }
+  int32_t *limbs = (int32_t *)PyBytes_AS_STRING(out);
+  /* subgroup check is a ~255-bit scalar mul: release the GIL like the
+   * other heavy entry points */
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_bls_g1_decompress((const uint8_t *)buf.buf, limbs, limbs + 32,
+                                  check);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  return Py_BuildValue("(iN)", rc, out);
+}
+
+static PyObject *py_bls_g2_decompress(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  int check = 1, rc;
+  if (!PyArg_ParseTuple(args, "y*|i", &buf, &check)) return NULL;
+  if (buf.len != 96) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "G2 compressed point must be 96 bytes");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, 128 * 4);
+  if (!out) { PyBuffer_Release(&buf); return NULL; }
+  int32_t *limbs = (int32_t *)PyBytes_AS_STRING(out);
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_bls_g2_decompress((const uint8_t *)buf.buf, limbs, limbs + 64,
+                                  check);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  return Py_BuildValue("(iN)", rc, out);
+}
+
+static PyObject *py_bls_hash_to_g2(PyObject *self, PyObject *args) {
+  Py_buffer msg, dst;
+  int rc;
+  if (!PyArg_ParseTuple(args, "y*y*", &msg, &dst)) return NULL;
+  PyObject *out = PyBytes_FromStringAndSize(NULL, 128 * 4);
+  if (!out) { PyBuffer_Release(&msg); PyBuffer_Release(&dst); return NULL; }
+  int32_t *limbs = (int32_t *)PyBytes_AS_STRING(out);
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_bls_hash_to_g2((const uint8_t *)msg.buf, (size_t)msg.len,
+                               (const uint8_t *)dst.buf, (size_t)dst.len,
+                               limbs, limbs + 64);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&msg);
+  PyBuffer_Release(&dst);
+  return Py_BuildValue("(iN)", rc, out);
+}
+
+static PyObject *py_bls_g1_aggregate(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  int check = 1, rc;
+  if (!PyArg_ParseTuple(args, "y*|i", &buf, &check)) return NULL;
+  if (buf.len % 48 != 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "pubkeys must be N*48 bytes");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, 64 * 4);
+  if (!out) { PyBuffer_Release(&buf); return NULL; }
+  int32_t *limbs = (int32_t *)PyBytes_AS_STRING(out);
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_bls_g1_aggregate((const uint8_t *)buf.buf,
+                                 (size_t)(buf.len / 48), check, limbs,
+                                 limbs + 32);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&buf);
+  return Py_BuildValue("(iN)", rc, out);
+}
+
+static PyObject *py_bls_marshal_sets(PyObject *self, PyObject *args) {
+  Py_buffer pks, msgs, sigs, dst;
+  int check_pk = 0, check_sig = 1;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*|ii", &pks, &msgs, &sigs, &dst,
+                        &check_pk, &check_sig))
+    return NULL;
+  Py_ssize_t n = pks.len / 48;
+  PyObject *out = NULL, *ok = NULL;
+  if (pks.len % 48 != 0 || msgs.len != n * 32 || sigs.len != n * 96) {
+    PyErr_SetString(PyExc_ValueError,
+                    "need n*48 pubkey, n*32 message, n*96 signature bytes");
+    goto done;
+  }
+  /* layout: [pk_x n*32 | pk_y n*32 | msg_x n*64 | msg_y n*64 |
+   *          sig_x n*64 | sig_y n*64] int32 */
+  out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)(n * 320 * 4));
+  ok = PyBytes_FromStringAndSize(NULL, n);
+  if (!out || !ok) goto done;
+  {
+    int32_t *base = (int32_t *)PyBytes_AS_STRING(out);
+    int32_t *pk_x = base, *pk_y = base + n * 32, *msg_x = base + n * 64,
+            *msg_y = base + n * 128, *sig_x = base + n * 192,
+            *sig_y = base + n * 256;
+    uint8_t *okp = (uint8_t *)PyBytes_AS_STRING(ok);
+    Py_BEGIN_ALLOW_THREADS
+    lodestar_bls_marshal_sets((size_t)n, (const uint8_t *)pks.buf,
+                              (const uint8_t *)msgs.buf,
+                              (const uint8_t *)sigs.buf,
+                              (const uint8_t *)dst.buf, (size_t)dst.len,
+                              check_pk, check_sig, pk_x, pk_y, msg_x, msg_y,
+                              sig_x, sig_y, okp);
+    Py_END_ALLOW_THREADS
+  }
+done:
+  PyBuffer_Release(&pks);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&sigs);
+  PyBuffer_Release(&dst);
+  if (!out || !ok) {
+    Py_XDECREF(out);
+    Py_XDECREF(ok);
+    return NULL;
+  }
+  return Py_BuildValue("(NN)", out, ok);
+}
+
 static PyMethodDef methods[] = {
     {"sha256", py_sha256, METH_VARARGS, "SHA-256 digest"},
     {"sha256_level", py_sha256_level, METH_VARARGS,
@@ -138,6 +282,16 @@ static PyMethodDef methods[] = {
     {"snappy_compress", py_snappy_compress, METH_VARARGS, "snappy block compress"},
     {"snappy_uncompress", py_snappy_uncompress, METH_VARARGS,
      "snappy block uncompress"},
+    {"bls_g1_decompress", py_bls_g1_decompress, METH_VARARGS,
+     "48B compressed G1 -> (rc, x||y device limbs int32[64])"},
+    {"bls_g2_decompress", py_bls_g2_decompress, METH_VARARGS,
+     "96B compressed G2 -> (rc, x||y device limbs int32[128])"},
+    {"bls_hash_to_g2", py_bls_hash_to_g2, METH_VARARGS,
+     "hash_to_curve G2 (RFC 9380) -> (rc, x||y device limbs int32[128])"},
+    {"bls_g1_aggregate", py_bls_g1_aggregate, METH_VARARGS,
+     "N*48B pubkeys -> (rc, x||y device limbs of the sum)"},
+    {"bls_marshal_sets", py_bls_marshal_sets, METH_VARARGS,
+     "batch: pubkeys/messages/signatures -> (device limb buffer, ok flags)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {PyModuleDef_HEAD_INIT, "_lodestar_native",

@@ -35,7 +35,9 @@ from .limbs import (
     ONE_MONT_LIMBS,
     P_LIMBS,
     R2_LIMBS,
+    R_MONT,
     TWO_P_LIMBS,
+    int_to_limbs,
 )
 
 _P = jnp.asarray(P_LIMBS)
@@ -45,12 +47,11 @@ _ONE_MONT = jnp.asarray(ONE_MONT_LIMBS)
 
 
 def carry_scan(t: jnp.ndarray) -> jnp.ndarray:
-    """Exact carry/borrow propagation -> canonical 12-bit limbs.
+    """Sequential carry propagation (reference implementation).
 
-    Works for signed inputs: `>>` is arithmetic shift and `& MASK` is the
-    positive remainder, so borrows ripple as negative carries. The final
-    carry out of the top limb is dropped (callers guarantee the value fits
-    384 bits and is non-negative).
+    Kept as the differential oracle for `ks_carry` and for ad-hoc use; hot
+    paths use the log-depth `ks_carry` instead — a 32/64-step `lax.scan`
+    of tiny steps is pure dispatch latency on TPU.
     """
     tt = jnp.moveaxis(t, -1, 0)
 
@@ -62,30 +63,98 @@ def carry_scan(t: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(out, 0, -1)
 
 
-def _lex_ge(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
-    """a >= m comparing canonical limb vectors (trailing limb axis)."""
-    diff = a - m
-    nz = diff != 0
-    pos = diff > 0
-    rev_nz = jnp.flip(nz, axis=-1)
-    first = jnp.argmax(rev_nz, axis=-1)  # index (from top) of highest nonzero
-    idx = (N_LIMBS - 1 - first)[..., None]
-    top_sign = jnp.take_along_axis(pos, idx, axis=-1)[..., 0]
-    return jnp.where(nz.any(axis=-1), top_sign, True)
+def _ks_carry_impl(t: jnp.ndarray):
+    """Log-depth signed carry/borrow propagation -> (canonical limbs, out).
+
+    Accepts signed columns with |t| < 2^30 whose VALUE (Σ t_i·2^(12i)) is
+    non-negative; returns limbs in [0, 2^12) plus the unmasked top residue
+    `out` (what carries past the last column — callers append a zero column
+    when they need it, or rely on the value fitting to drop it).
+
+    Structure (everything fuses — no lax.scan, no sequential chain):
+      1. three shift-folds with arithmetic shifts: digits land in [-1, 2^12]
+         (fold1 carries ≤ 2^18, fold2 ≤ 2^6+1, fold3 ≤ 1 — signed).
+      2. the residual ±1 carry chain is a Kogge–Stone prefix over monotone
+         carry maps {-1,0,1}→{-1,0,1}, each map encoded by its three
+         outputs; composition is 3 selects, ⌈log2(K)⌉ rounds.
+    """
+    k = t.shape[-1]
+
+    def fold(x):
+        c = x >> LIMB_BITS
+        return (x & LIMB_MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1
+        )
+
+    t = fold(fold(fold(t)))  # digits ∈ [-1, 2^12]
+    # per-position carry map: f(c) = (d + c) >> 12 for carry-in c ∈ {-1,0,1}
+    lo = (t - 1) >> LIMB_BITS
+    mid = t >> LIMB_BITS
+    hi = (t + 1) >> LIMB_BITS
+
+    def ev(gl, gm, gh, v):
+        """Evaluate map g (its three outputs) at v ∈ {-1,0,1}."""
+        return jnp.where(v < 0, gl, jnp.where(v > 0, gh, gm))
+
+    L, M, H = lo, mid, hi
+    shift = 1
+    while shift < k:
+        def pad(x, fill):
+            return jnp.concatenate(
+                [jnp.full_like(x[..., :shift], fill), x[..., :-shift]], axis=-1
+            )
+
+        # inclusive prefix: map_i ← map_i ∘ map_{i-shift} (identity fill)
+        fl, fm, fh = pad(L, -1), pad(M, 0), pad(H, 1)
+        L, M, H = ev(L, M, H, fl), ev(L, M, H, fm), ev(L, M, H, fh)
+        shift *= 2
+
+    # carry into position i = (prefix map through i-1)(0) = that map's mid
+    cin = jnp.concatenate([jnp.zeros_like(M[..., :1]), M[..., :-1]], axis=-1)
+    digits = (t + cin) & LIMB_MASK
+    out = M[..., -1]  # carry past the top column
+    return digits, out
 
 
-def _cond_sub(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
-    """a - m if a >= m else a; a canonical, result canonical."""
-    ge = _lex_ge(a, m)
-    return carry_scan(a - jnp.where(ge[..., None], m, 0))
+def ks_carry(t: jnp.ndarray) -> jnp.ndarray:
+    """Log-depth carry propagation; drops the out-carry (callers guarantee
+    the non-negative value fits the column count). Contract of
+    `carry_scan`, fused implementation."""
+    digits, _ = _ks_carry_impl(t)
+    return digits
+
+
+def _carry_out(t: jnp.ndarray):
+    """ks_carry + the value carried past the top column (appends a zero
+    column so fold carries are captured, not dropped). The extension
+    column is masked like every limb, so the out value is only exact for
+    carries < 2^12 — ample for the complement-add use (carry ∈ {0,1})."""
+    ext = jnp.concatenate([t, jnp.zeros_like(t[..., :1])], axis=-1)
+    digits, _ = _ks_carry_impl(ext)
+    return digits[..., :-1], digits[..., -1]
+
+
+def _cond_sub(a: jnp.ndarray, comp_m: jnp.ndarray) -> jnp.ndarray:
+    """a - m if a >= m else a, with comp_m = 2^384 - m precomputed.
+
+    Complement-add: y = a + (2^384 - m) overflows bit 384 exactly when
+    a >= m, and then the truncated y IS a - m. One fused carry + select —
+    no lexicographic compare, no borrow chain.
+    """
+    y, out = _carry_out(a + comp_m)
+    return jnp.where(out[..., None] > 0, y, a)
+
+
+_COMP_TWO_P = jnp.asarray(int_to_limbs((1 << 384) - 2 * _P_INT))
+_COMP_P = jnp.asarray(int_to_limbs((1 << 384) - _P_INT))
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _cond_sub(carry_scan(a + b), _TWO_P)
+    return _cond_sub(ks_carry(a + b), _COMP_TWO_P)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _cond_sub(carry_scan(a - b + _TWO_P), _TWO_P)
+    return _cond_sub(ks_carry(a - b + _TWO_P), _COMP_TWO_P)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
@@ -96,27 +165,56 @@ def double(a: jnp.ndarray) -> jnp.ndarray:
     return add(a, a)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product REDC(a*b): inputs < 2p, output < 2p.
+# full-width -p^-1 mod R as 32 12-bit limbs (for the fused REDC)
+_NPRIME = jnp.asarray(int_to_limbs((-pow(_P_INT, -1, R_MONT)) % R_MONT))
 
-    Schoolbook convolution into 64 uncarried int32 columns (each < 2^29),
-    then word-by-word Montgomery reduction as a 32-step scan. Peak column
-    value stays < 2^31 (see limbs.py for the bound).
 
-    LODESTAR_TPU_PALLAS_MUL=1 routes through the Pallas VMEM-resident
-    kernel (`ops/pallas_fp.py`) instead — same contract, one HBM
-    round-trip per batch tile on TPU hardware.
+def _conv_matrix() -> np.ndarray:
+    """(N²,2N) 0/1 f32: flattened outer-product index (i,j) → column i+j."""
+    s = np.zeros((N_LIMBS * N_LIMBS, 2 * N_LIMBS), np.float32)
+    for i in range(N_LIMBS):
+        for j in range(N_LIMBS):
+            s[i * N_LIMBS + j, i + j] = 1.0
+    return s
+
+
+_S = jnp.asarray(_conv_matrix())
+
+
+def conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Column convolution of 12-bit limb vectors via a fixed MXU matmul.
+
+    a, b: (..., N) canonical 12-bit limbs → (..., 2N) int32 columns.
+    The ≤2^24 products are split into three 8-bit parts: each part is
+    ≤ 255, EXACT in bf16 (8-bit mantissa), so the TPU's DEFAULT-precision
+    single-pass matmul is bit-exact — parts × 0/1 entries accumulate in
+    f32 with partial sums ≤ 32·2^8 ≪ 2^24. Measured (BASELINE.md): three
+    one-pass matmuls beat two six-pass HIGHEST ones and the VPU scan.
     """
-    import os
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (N_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (N_LIMBS,))
+    outer = (a[..., :, None] * b[..., None, :]).reshape(batch + (N_LIMBS * N_LIMBS,))
+    p0 = (outer & 0xFF).astype(jnp.float32)
+    p1 = ((outer >> 8) & 0xFF).astype(jnp.float32)
+    p2 = (outer >> 16).astype(jnp.float32)
+    c0 = jnp.matmul(p0, _S, preferred_element_type=jnp.float32)
+    c1 = jnp.matmul(p1, _S, preferred_element_type=jnp.float32)
+    c2 = jnp.matmul(p2, _S, preferred_element_type=jnp.float32)
+    return (
+        c0.astype(jnp.int32)
+        + (c1.astype(jnp.int32) << 8)
+        + (c2.astype(jnp.int32) << 16)
+    )
 
-    if os.environ.get("LODESTAR_TPU_PALLAS_MUL") == "1":
-        from .pallas_fp import mont_mul
 
-        return mont_mul(a, b)
-    if os.environ.get("LODESTAR_TPU_MXU_MUL") == "1":
-        from . import mxu_fp
+def _mul_scan(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Round-1 word-serial Montgomery multiply (32-step REDC scan).
 
-        return mxu_fp.mul(a, b)
+    Kept as a differential reference and LODESTAR_TPU_LEGACY_FP=1 fallback;
+    superseded by `_mul_fused` — the scan's 32 sequential steps are
+    dispatch latency the fused path eliminates.
+    """
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (N_LIMBS,))
     b = jnp.broadcast_to(b, batch + (N_LIMBS,))
@@ -124,11 +222,6 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     for i in range(N_LIMBS):  # static unroll: 32 vector multiply-adds
         t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
 
-    # Montgomery reduction as a 32-step lax.scan. A statically-unrolled
-    # variant was measured on v5e: ~3% faster at run time but it multiplies
-    # the HLO of every consumer (the full batch kernel's first compile went
-    # from ~3 min to >20 min) — the scan keeps the graph compact, which is
-    # the right trade for a kernel compiled per batch-bucket.
     def redc_step(t, i):
         chunk = lax.dynamic_slice_in_dim(t, i, N_LIMBS, axis=-1)
         m = (chunk[..., 0:1] * N0) & LIMB_MASK
@@ -140,6 +233,84 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
     t, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
     return carry_scan(t[..., N_LIMBS:])
+
+
+def _mul_fused(a: jnp.ndarray, b: jnp.ndarray, carry=None) -> jnp.ndarray:
+    """Fused Montgomery multiply: MXU convolutions + full-width REDC +
+    log-depth carries — zero `lax.scan`s, so whole tower operations
+    compile into a handful of fused kernels instead of hundreds of
+    sequential scan steps.
+
+        t = a·b            (conv as three exact bf16 matmuls)
+        m = (t mod R)·N' mod R
+        out = (t + m·p) / R
+
+    `carry` parameterizes the carry-propagation strategy (default
+    `ks_carry`; `mxu_fp.mul` passes its generate/propagate variant) so
+    the consensus-critical REDC pipeline exists exactly once.
+
+    Bounds: conv columns < 2^29, t+u columns < 2^30 (ks_carry's limit);
+    output < 2p for inputs < 2p: t < (2p)² so t/R < 4p²/R < p
+    (R = 2^384 > 4p); m·p/R < p; result < 2p.
+    """
+    if carry is None:
+        carry = ks_carry
+    t_cols = conv(a, b)
+    t = carry(t_cols)  # (2p)² < 2^768 fits 64 limbs: no out-carry
+    m_cols = conv(t[..., :N_LIMBS], _NPRIME)[..., :N_LIMBS]
+    m = carry(m_cols)  # mod R = drop the out-carry
+    u_cols = conv(m, _P)
+    summed = carry(t_cols + u_cols)  # t+u < 2^766: no out-carry
+    # low 32 limbs are ≡ 0 by construction of m; result = (t+u) >> 384
+    return summed[..., N_LIMBS:]
+
+
+_DEFAULT_IMPL = None
+
+
+def _default_impl():
+    """Pick the default multiply once per process.
+
+    TPU: `_mul_fused` — the MXU convolution + full-width REDC design
+    (BASELINE.md measured it ahead of the scan path on v5e). Other
+    backends (CPU tests / virtual mesh): the word-serial scan — the
+    (B,1024)@(1024,64) constant matmuls that feed the MXU are a large
+    compile-time and runtime pessimization on the CPU backend. Both
+    paths are differentially pinned against the big-int oracle either
+    way (tests/test_ops_fp.py).
+    """
+    global _DEFAULT_IMPL
+    if _DEFAULT_IMPL is None:
+        import jax
+
+        _DEFAULT_IMPL = _mul_fused if jax.default_backend() == "tpu" else _mul_scan
+    return _DEFAULT_IMPL
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product REDC(a*b): inputs < 2p, output < 2p.
+
+    Default path on TPU is `_mul_fused` (MXU convolution + full-width
+    REDC); on other backends the word-serial scan (see `_default_impl`).
+    Env overrides: LODESTAR_TPU_PALLAS_MUL=1 routes through the Pallas
+    VMEM-resident kernel (`ops/pallas_fp.py`); LODESTAR_TPU_LEGACY_FP=1
+    forces the round-1 word-serial scan; LODESTAR_TPU_MXU_MUL=1 (round
+    1's opt-in flag for the then-experimental MXU path) forces the
+    `mxu_fp.mul` carry variant on any backend.
+    """
+    import os
+
+    if os.environ.get("LODESTAR_TPU_PALLAS_MUL") == "1":
+        from .pallas_fp import mont_mul
+
+        return mont_mul(a, b)
+    if os.environ.get("LODESTAR_TPU_LEGACY_FP") == "1":
+        return _mul_scan(a, b)
+    if os.environ.get("LODESTAR_TPU_MXU_MUL") == "1":
+        from . import mxu_fp
+
+        return mxu_fp.mul(a, b)
+    return _default_impl()(a, b)
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
@@ -154,12 +325,12 @@ def to_mont(a: jnp.ndarray) -> jnp.ndarray:
 def from_mont(a: jnp.ndarray) -> jnp.ndarray:
     """Montgomery form -> canonical normal-domain limbs (< p)."""
     one = jnp.zeros(N_LIMBS, jnp.int32).at[0].set(1)
-    return _cond_sub(mul(a, one), _P)
+    return _cond_sub(mul(a, one), _COMP_P)
 
 
 def canonical(a: jnp.ndarray) -> jnp.ndarray:
     """Reduce the [0, 2p) representative to the unique [0, p) form."""
-    return _cond_sub(a, _P)
+    return _cond_sub(a, _COMP_P)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:

@@ -1,95 +1,56 @@
-"""Experimental MXU-mapped field multiply (the BASELINE.md plan).
+"""MXU-mapped field multiply — the experiment that became `fp.mul`.
 
-Two structural changes vs `fp.mul`:
+Round 1 developed this module as the opt-in experiment mapping the limb
+convolution onto the MXU (see BASELINE.md for measured results); round 2
+promoted the design into the default `fp.mul` path. The convolution and
+the full-width REDC pipeline now live in `fp` (`fp.conv`,
+`fp._mul_fused`) so the consensus-critical reduction exists exactly once;
+this module keeps:
+
+- `_carry`: the original generate/propagate Kogge–Stone carry (unsigned,
+  bit-carry adder form) — a differential counterpart to `fp.ks_carry`'s
+  signed carry-map form;
+- `_carry_scan`: the sequential reference carry;
+- `mul`: the fused pipeline instantiated with `_carry`, selectable at
+  runtime via LODESTAR_TPU_MXU_MUL=1 (round 1's opt-in flag).
+
+Design notes and measured numbers (v5e, 100 chained muls @4096 lanes):
 
 1. **Convolutions as fixed matmuls.** The 32-limb schoolbook product is
    `t[k] = Σ_{i+j=k} a_i·b_j` — an outer product (VPU) followed by a
    contraction with a FIXED 0/1 tensor, i.e. one `(B,1024) @ (1024,64)`
    matmul with a constant matrix — MXU work. Products are ≤ 2^24, so
-   each is split into three 8-bit parts (see `_conv`): bf16 holds ≤255
-   exactly and the MXU accumulates in f32, so single-pass
-   DEFAULT-precision matmuls produce bit-exact integer results.
+   each is split into three 8-bit parts: bf16 holds ≤255 exactly and the
+   MXU accumulates in f32, so single-pass DEFAULT-precision matmuls are
+   bit-exact. First cut (12-bit splits, HIGHEST precision = 6-pass) lost
+   (119 ms vs 112 ms); the 8-bit split WINS: 95 ms vs 104 ms (~9% over
+   the VPU scan path).
 
 2. **Full-width Montgomery reduction.** Instead of the word-serial
    32-step REDC scan, the textbook full-radix form:
        m = (t mod R)·N' mod R,   result = (t + m·p) / R
-   with N' = -p^{-1} mod R precomputed at full width. Both extra
-   products are the same fixed-matmul convolution — the only sequential
-   work left is carry propagation (three `lax.scan` passes of cheap
-   add/shift steps).
+   with N' = -p^{-1} mod R precomputed at full width. The only
+   sequential work left is carry propagation, done in log depth.
 
-Contract matches `fp.mul`: inputs < 2p (lazy domain), output < 2p.
-Proof of the output bound: t < (2p)² so t/R < 4p²/R < p (R = 2^384 >
-4p); m·p/R < p; result < 2p. ✓
-
-Measured (v5e, 100 chained muls @4096 lanes): the first cut used
-two six-pass HIGHEST-precision matmuls and lost (119 ms vs 112 ms);
-splitting products into three 8-bit parts makes single-pass
-DEFAULT-precision (bf16-input, f32-accumulate) matmuls bit-exact and
-WINS: 95 ms vs 104 ms (~9% faster than the VPU scan path). Replacing
-the three sequential carry scans with shift-folds + a Kogge-Stone
-prefix (log-depth, ~9 parallel steps) measured perf-neutral at this
-shape (96.6 vs 95.1 ms) but removes the 160-step sequential chain —
-kept for its asymptotics. Opt-in via LODESTAR_TPU_MXU_MUL=1; the
-differential suite pins every piece (lookahead vs scan, mul vs the
-big-int oracle) either way.
+Contract matches `fp.mul`: inputs < 2p (lazy domain), output < 2p
+(bound proof in `fp._mul_fused`).
 """
 
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from ..bls.fields import P as _P_INT
-from .limbs import LIMB_BITS, LIMB_MASK, N_LIMBS, P_LIMBS, R_MONT, int_to_limbs
+from . import fp
+from .limbs import LIMB_BITS, LIMB_MASK
 
-# full-width -p^-1 mod R as 32 12-bit limbs
-_NPRIME_INT = (-pow(_P_INT, -1, R_MONT)) % R_MONT
-_NPRIME = jnp.asarray(int_to_limbs(_NPRIME_INT))
-_P = jnp.asarray(P_LIMBS)
-
-
-def _conv_matrix() -> np.ndarray:
-    """(N²,2N) 0/1 f32: flattened outer-product index (i,j) → column i+j."""
-    s = np.zeros((N_LIMBS * N_LIMBS, 2 * N_LIMBS), np.float32)
-    for i in range(N_LIMBS):
-        for j in range(N_LIMBS):
-            s[i * N_LIMBS + j, i + j] = 1.0
-    return s
+# re-exported for back-compat: round-1 callers/tests reached these here
+_NPRIME = fp._NPRIME
+_P = fp._P
+_conv = fp.conv
 
 
-_S = jnp.asarray(_conv_matrix())
-
-
-def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Column convolution of 12-bit limb vectors via the fixed matmul.
-
-    a, b: (..., N) canonical 12-bit limbs → (..., 2N) int32 columns
-    (≤ 32·2^24 — the caller's bound analysis keeps totals in int32)."""
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    a = jnp.broadcast_to(a, batch + (N_LIMBS,))
-    b = jnp.broadcast_to(b, batch + (N_LIMBS,))
-    outer = (a[..., :, None] * b[..., None, :]).reshape(batch + (N_LIMBS * N_LIMBS,))
-    # Split the ≤2^24 products into three 8-bit parts: each part is ≤ 255,
-    # EXACT in bf16 (8-bit mantissa), so the TPU's DEFAULT-precision
-    # (single-pass bf16) matmul is bit-exact — parts × 0/1 entries
-    # accumulate in f32 with sums ≤ 32·2^8 ≪ 2^24. Three one-pass matmuls
-    # beat two six-pass HIGHEST ones.
-    p0 = (outer & 0xFF).astype(jnp.float32)
-    p1 = ((outer >> 8) & 0xFF).astype(jnp.float32)
-    p2 = (outer >> 16).astype(jnp.float32)
-    c0 = jnp.matmul(p0, _S, preferred_element_type=jnp.float32)
-    c1 = jnp.matmul(p1, _S, preferred_element_type=jnp.float32)
-    c2 = jnp.matmul(p2, _S, preferred_element_type=jnp.float32)
-    return (
-        c0.astype(jnp.int32)
-        + (c1.astype(jnp.int32) << 8)
-        + (c2.astype(jnp.int32) << 16)
-    )
-
-
-def _carry(t: jnp.ndarray) -> jnp.ndarray:
+def _carry(t: jnp.ndarray):
     """Log-depth carry propagation (carry-lookahead), dropping the final
     out-carry (callers' bound analysis guarantees it is irrelevant).
 
@@ -97,7 +58,10 @@ def _carry(t: jnp.ndarray) -> jnp.ndarray:
     [0, 2^12]: the first fold's carries are ≤ 2^18, the second's ≤ 2^7,
     the third's ≤ 1. What remains is a bit-carry adder solved by a
     Kogge-Stone generate/propagate prefix in ⌈log2(n)⌉ steps — ~9
-    parallel steps total instead of an n-step sequential scan."""
+    parallel steps total instead of an n-step sequential scan.
+
+    Unsigned-columns-only counterpart to `fp.ks_carry` (which also
+    handles borrows); kept as a differential reference for it."""
     mask = LIMB_MASK
 
     def fold(x):
@@ -144,19 +108,6 @@ def _carry_scan(t: jnp.ndarray):
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Montgomery product REDC(a·b) via MXU convolutions; contract as
-    fp.mul (inputs < 2p, output < 2p)."""
-    # t = a·b, fully carried to canonical limbs (values < (2p)² < R²)
-    t_cols = _conv(a, b)
-    t, t_carry = _carry(t_cols)  # t_carry == 0: (2p)² < 2^768 exactly fits 64 limbs
-
-    # m = (t mod R)·N' mod R — low half convolution, carried, truncated
-    m_cols = _conv(t[..., :N_LIMBS], _NPRIME)[..., :N_LIMBS]
-    m, _ = _carry(m_cols)  # mod R = drop the out-carry
-
-    # u = m·p; t + u ≡ 0 mod R ⇒ (t + u)/R is exact after carrying
-    u_cols = _conv(m, _P)
-    total = t_cols + u_cols  # columns ≤ 2·32·2^24 < 2^30: still int32-safe
-    summed, _out = _carry(total)  # t+u < 2^766 fits 64 limbs: no out-carry
-    # low 32 limbs are ≡ 0 by construction of m; result = (t+u) >> 384
-    return summed[..., N_LIMBS:]
+    """Montgomery product REDC(a·b) — the shared fused pipeline with this
+    module's generate/propagate carry; contract as fp.mul."""
+    return fp._mul_fused(a, b, carry=lambda t: _carry(t)[0])

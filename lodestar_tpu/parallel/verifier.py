@@ -196,12 +196,45 @@ class TpuBlsVerifier:
     # -- host marshalling ---------------------------------------------------
 
     def _marshal(self, sets) -> SetArrays | None:
-        """Build padded device arrays; None if any set is invalid up front."""
+        """Build padded device arrays; None if any set is invalid up front.
+
+        Fast path: the native C tier (`native/src/bls12.c`) decompresses,
+        subgroup-checks and hash-to-curves the whole batch in one call —
+        the reference keeps exactly this preprocessing in blst C
+        (multithread/worker.ts:33-55). Falls back to the big-int oracle
+        when the extension is unavailable.
+        """
         if not sets:
             return None
         lanes = self.kernels.bucket_for(len(sets))
         if len(sets) > lanes:
             return None  # caller must chunk (service layer's job)
+        from .. import native as _native
+
+        if _native.HAVE_NATIVE_BLS and all(
+            len(s.message) == 32 and len(s.signature) == 96 for s in sets
+        ):
+            # the C tier assumes fixed 32B signing roots (every consensus
+            # message is one); odd-length messages take the oracle path below
+            try:
+                pk_b = b"".join(s.pubkey.to_bytes() for s in sets)
+            except (bls_api.BlsError, ValueError):
+                return None
+            msg_b = b"".join(s.message for s in sets)
+            sig_b = b"".join(s.signature for s in sets)
+            pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, ok = _native.bls_marshal_sets(
+                pk_b, msg_b, sig_b, bls_api.DST_G2
+            )
+            if not ok.all():
+                return None
+            arrs = SetArrays(lanes)
+            n = len(sets)
+            arrs.pk_x[:n], arrs.pk_y[:n] = pk_x, pk_y
+            arrs.msg_x[:n], arrs.msg_y[:n] = msg_x, msg_y
+            arrs.sig_x[:n], arrs.sig_y[:n] = sig_x, sig_y
+            arrs.valid[:n] = True
+            arrs.n = n
+            return arrs
         arrs = SetArrays(lanes)
         for i, s in enumerate(sets):
             if s.pubkey.point.is_infinity():

@@ -110,3 +110,33 @@ def test_vmap_consistency():
     direct = fp.mul(a, b)
     vmapped = jax.vmap(fp.mul)(a, b)
     assert np.array_equal(np.asarray(direct), np.asarray(vmapped))
+
+
+def test_canonical_at_modulus_boundary():
+    """Regression: values in [p, 2p) must canonicalize below p — the
+    complement-add _cond_sub must fire exactly when a >= m (round-2 review
+    caught canonical(p) == p with the stale plain-modulus argument)."""
+    from lodestar_tpu.bls.fields import P
+    from lodestar_tpu.ops.limbs import int_to_limbs, limbs_to_int
+
+    for v in (P, P + 1, P + 12345, 2 * P - 1, P - 1, 0, 1):
+        limbs = jnp.asarray(int_to_limbs(v))
+        got = limbs_to_int(np.asarray(jax.jit(fp.canonical)(limbs)))
+        assert got == v % P, f"canonical({v}) -> {got}"
+    assert bool(jax.jit(fp.is_zero)(jnp.asarray(int_to_limbs(P))))
+    assert not bool(jax.jit(fp.is_zero)(jnp.asarray(int_to_limbs(P - 1))))
+
+
+def test_mul_all_impls_against_oracle():
+    """Every multiply implementation — including the exact shipped TPU
+    default combination (_mul_fused + ks_carry) that CPU runs would
+    otherwise never exercise — must match the big-int oracle."""
+    from lodestar_tpu.ops import mxu_fp
+
+    xs = [0, 1, P - 1, P - 2] + [rand_fp() for _ in range(8)]
+    ys = [P - 1, 0, P - 1, 2] + [rand_fp() for _ in range(8)]
+    a, b = to_dev(xs), to_dev(ys)
+    ref = [(x * y) % P for x, y in zip(xs, ys)]
+    assert from_dev(jax.jit(fp._mul_fused)(a, b)) == ref  # TPU default
+    assert from_dev(jax.jit(fp._mul_scan)(a, b)) == ref   # CPU default
+    assert from_dev(jax.jit(mxu_fp.mul)(a, b)) == ref     # g/p-carry variant
