@@ -84,6 +84,7 @@ class BeaconChain:
         proto = ProtoArray(
             justified_epoch=anchor_state.current_justified_checkpoint.epoch,
             finalized_epoch=anchor_state.finalized_checkpoint.epoch,
+            slots_per_epoch=self.preset.SLOTS_PER_EPOCH,
         )
         proto.on_block(
             anchor_state.slot,
@@ -105,7 +106,14 @@ class BeaconChain:
             ),
             justified_balances=cached.flat.effective_balance.astype(np.int64),
         )
-        self.fork_choice = ForkChoice(store, proto, self.preset.SLOTS_PER_EPOCH)
+        self.fork_choice = ForkChoice(
+            store,
+            proto,
+            self.preset.SLOTS_PER_EPOCH,
+            seconds_per_slot=config.SECONDS_PER_SLOT,
+            proposer_score_boost=config.PROPOSER_SCORE_BOOST,
+            safe_slots_to_update_justified=self.preset.SAFE_SLOTS_TO_UPDATE_JUSTIFIED,
+        )
         self.head_root = anchor_root
 
         self.state_cache = StateContextCache()
@@ -228,6 +236,27 @@ class BeaconChain:
         prev_finalized = self.fork_choice.store.finalized_checkpoint[0]
         # fork choice
         self.fork_choice.update_time(max(self.clock.current_slot, block.slot))
+        # unrealized checkpoints: what FFG would reach if the epoch ended
+        # now — feeds tip pull-up + prior-epoch viability (reference
+        # forkChoice.ts:406-453 via computeUnrealizedCheckpoints)
+        try:
+            from ..state_transition.unrealized import compute_unrealized_checkpoints
+
+            unrealized_j, unrealized_f = compute_unrealized_checkpoints(
+                post, self.types
+            )
+        except Exception:
+            # degrading to realized checkpoints keeps import alive, but
+            # silently losing pull-up would be undiagnosable — log it
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "compute_unrealized_checkpoints failed; using realized"
+            )
+            unrealized_j = unrealized_f = None
+        # timeliness for the proposer boost: seconds since the block's
+        # slot started, at import time
+        block_delay = self.clock.time_fn() - self.clock.time_at_slot(block.slot)
         self.fork_choice.on_block(
             block.slot,
             block_root,
@@ -242,6 +271,9 @@ class BeaconChain:
                 bytes(state.finalized_checkpoint.root),
             ),
             justified_balances=post.flat.effective_balance.astype(np.int64),
+            unrealized_justified_checkpoint=unrealized_j,
+            unrealized_finalized_checkpoint=unrealized_f,
+            block_delay_sec=block_delay,
         )
         # per-attestation fork-choice votes (importBlock.ts:88-130)
         for att in block.body.attestations:

@@ -24,6 +24,11 @@ class ProtoNode:
     state_root: bytes
     justified_epoch: int
     finalized_epoch: int
+    # what the checkpoints would be if the block's epoch ended at import
+    # time (reference protoArray/interface.ts:71-74); used by the
+    # viability filter for blocks from prior epochs
+    unrealized_justified_epoch: int = 0
+    unrealized_finalized_epoch: int = 0
     # execution status is tracked for bellatrix+ (optimistic sync);
     # "valid" for pre-merge blocks
     execution_status: str = "pre_merge"  # pre_merge | valid | syncing | invalid
@@ -40,12 +45,18 @@ class ProtoArray:
         self,
         justified_epoch: int,
         finalized_epoch: int,
+        slots_per_epoch: int = 32,
     ):
         self.nodes: list[ProtoNode] = []
         self.indices: dict[bytes, int] = {}
         self.weights = np.zeros(0, np.int64)
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        self.slots_per_epoch = slots_per_epoch
+        self.current_slot = 0  # refreshed by apply_score_changes
+        # boost applied in the previous score pass, to back out before
+        # applying this pass's boost (reference previousProposerBoost)
+        self.previous_proposer_boost: tuple[bytes, int] | None = None
         self.prune_threshold = 256
 
     # -- insertion -----------------------------------------------------------
@@ -59,6 +70,8 @@ class ProtoArray:
         justified_epoch: int,
         finalized_epoch: int,
         execution_status: str = "pre_merge",
+        unrealized_justified_epoch: int | None = None,
+        unrealized_finalized_epoch: int | None = None,
     ) -> None:
         if root in self.indices:
             return
@@ -72,6 +85,16 @@ class ProtoArray:
                 state_root=state_root,
                 justified_epoch=justified_epoch,
                 finalized_epoch=finalized_epoch,
+                unrealized_justified_epoch=(
+                    unrealized_justified_epoch
+                    if unrealized_justified_epoch is not None
+                    else justified_epoch
+                ),
+                unrealized_finalized_epoch=(
+                    unrealized_finalized_epoch
+                    if unrealized_finalized_epoch is not None
+                    else finalized_epoch
+                ),
                 execution_status=execution_status,
             )
         )
@@ -87,8 +110,13 @@ class ProtoArray:
         deltas: np.ndarray,
         justified_epoch: int,
         finalized_epoch: int,
+        proposer_boost: tuple[bytes, int] | None = None,
+        current_slot: int | None = None,
     ) -> None:
         """deltas: (len(nodes),) int64 — per-node vote weight change.
+        proposer_boost: (block_root, score) for this pass — the previous
+        pass's boost is backed out automatically (reference
+        protoArray.ts:145-148 currentBoost/previousBoost).
 
         TWO backward passes, as in the reference (protoArray.ts
         applyScoreChanges): first apply every weight and back-propagate
@@ -100,8 +128,21 @@ class ProtoArray:
             raise ProtoArrayError("delta/node length mismatch")
         self.justified_epoch = justified_epoch
         self.finalized_epoch = finalized_epoch
+        if current_slot is not None:
+            self.current_slot = current_slot
 
         deltas = deltas.astype(np.int64).copy()
+        # fold boosts into the deltas up front (one dict lookup each, not a
+        # root comparison per node); the invalid-node override below still
+        # discards them on an invalidated node
+        if proposer_boost is not None:
+            idx = self.indices.get(proposer_boost[0])
+            if idx is not None:
+                deltas[idx] += proposer_boost[1]
+        if self.previous_proposer_boost is not None:
+            idx = self.indices.get(self.previous_proposer_boost[0])
+            if idx is not None:
+                deltas[idx] -= self.previous_proposer_boost[1]
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
             if node.execution_status == "invalid":
@@ -115,6 +156,7 @@ class ProtoArray:
             parent = self.nodes[i].parent
             if parent is not None:
                 self._maybe_update_best_child_and_descendant(parent, i)
+        self.previous_proposer_boost = proposer_boost
 
     # -- head selection ------------------------------------------------------
 
@@ -130,14 +172,19 @@ class ProtoArray:
         return head.root
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """filter_block_tree equivalent (reference protoArray.ts:733-763):
+        blocks from a PREVIOUS epoch are judged on their unrealized
+        checkpoints — a tip that would justify the store's checkpoint if
+        its epoch ended now must stay viable, or every late-epoch fork
+        tip gets filtered and head selection can dead-end."""
         if node.execution_status == "invalid":
             return False
-        return (
-            node.justified_epoch == self.justified_epoch
-            or self.justified_epoch == 0
-        ) and (
-            node.finalized_epoch == self.finalized_epoch
-            or self.finalized_epoch == 0
+        current_epoch = self.current_slot // self.slots_per_epoch
+        from_prev_epoch = node.slot // self.slots_per_epoch < current_epoch
+        j = node.unrealized_justified_epoch if from_prev_epoch else node.justified_epoch
+        f = node.unrealized_finalized_epoch if from_prev_epoch else node.finalized_epoch
+        return (j == self.justified_epoch or self.justified_epoch == 0) and (
+            f == self.finalized_epoch or self.finalized_epoch == 0
         )
 
     def _node_leads_to_viable_head(self, node: ProtoNode) -> bool:

@@ -13,7 +13,7 @@ def _root(i: int) -> bytes:
     return i.to_bytes(32, "big")
 
 
-def make_fc(n_validators=8, balance=32):
+def make_fc(n_validators=8, balance=32, **kwargs):
     genesis = _root(0)
     proto = ProtoArray(justified_epoch=0, finalized_epoch=0)
     proto.on_block(0, genesis, None, b"\x00" * 32, 0, 0)
@@ -23,7 +23,7 @@ def make_fc(n_validators=8, balance=32):
         finalized_checkpoint=(0, genesis),
         justified_balances=np.full(n_validators, balance, np.int64),
     )
-    return ForkChoice(store, proto, slots_per_epoch=8)
+    return ForkChoice(store, proto, slots_per_epoch=8, **kwargs)
 
 
 def test_chain_head_follows_blocks():
@@ -119,3 +119,155 @@ def test_prune_keeps_post_finalized_tree():
     assert _root(5) in fc.proto.indices
     fc.store.justified_checkpoint = (0, _root(5))
     assert fc.update_head() == _root(9)
+
+
+# -- proposer boost (reference forkChoice.ts:207-222, protoArray.ts:145-148) --
+
+def test_proposer_boost_score_math():
+    fc = make_fc(n_validators=8, balance=32)
+    # committee weight per slot = total/SLOTS_PER_EPOCH = 8*32/8 = 32;
+    # boost = 32 * 40 // 100 = 12 (reference computeProposerBoostScore)
+    assert fc._compute_proposer_boost_score() == (8 * 32 // 8) * 40 // 100
+
+
+def test_timely_block_gets_boost_and_wins_tie():
+    fc = make_fc()
+    fc.update_time(1)
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.on_block(1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    # equal votes on both forks
+    fc.on_attestation([0, 1], _root(1), 0)
+    fc.on_attestation([2, 3], _root(2), 0)
+    fc.update_time(2)
+    # timely block on fork 1 at the current slot: arrives 1s into slot 2
+    fc.on_block(
+        2, _root(3), _root(1), b"", (0, _root(0)), (0, _root(0)),
+        block_delay_sec=1.0,
+    )
+    assert fc.proposer_boost_root == _root(3)
+    assert fc.update_head() == _root(3)
+    # the new tip carries exactly the boost (its ancestors carry the votes)
+    idx = fc.proto.indices[_root(3)]
+    assert fc.proto.weights[idx] == fc._compute_proposer_boost_score()
+    # and the boosted subtree outweighs the other fork
+    idx1 = fc.proto.indices[_root(1)]
+    idx2 = fc.proto.indices[_root(2)]
+    assert fc.proto.weights[idx1] > fc.proto.weights[idx2]
+
+
+def test_late_block_gets_no_boost_and_boost_expires():
+    fc = make_fc()
+    fc.update_time(1)
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    # late arrival: 5s into a 12s slot (>= 12/3) — no boost
+    fc.on_block(
+        1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)),
+        block_delay_sec=5.0,
+    )
+    assert fc.proposer_boost_root is None
+    # timely block this slot IS boosted, but the boost is backed out on
+    # the next slot tick (previousProposerBoost accounting)
+    fc.update_time(2)
+    fc.on_block(
+        2, _root(3), _root(1), b"", (0, _root(0)), (0, _root(0)),
+        block_delay_sec=0.5,
+    )
+    fc.update_head()
+    idx = fc.proto.indices[_root(3)]
+    assert fc.proto.weights[idx] > 0
+    fc.update_time(3)  # new slot: boost cleared
+    assert fc.proposer_boost_root is None
+    fc.update_head()
+    assert fc.proto.weights[idx] == 0
+
+
+def test_late_block_does_not_reorg_boosted_timely_head():
+    """The attack proposer boost exists to stop: a late competing block for
+    the same slot must not displace the boosted timely head when vote
+    weight alone would tie (and WOULD win the byte tie-break)."""
+    def run(boost_enabled):
+        fc = make_fc(proposer_boost_enabled=boost_enabled)
+        fc.update_time(1)
+        fc.on_block(
+            1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)),
+            block_delay_sec=0.1,  # timely
+        )
+        fc.on_attestation([0], _root(1), 0)
+        fc.on_block(
+            1, _root(2), _root(0), b"", (0, _root(0)), (0, _root(0)),
+            block_delay_sec=9.0,  # late
+        )
+        fc.on_attestation([1], _root(2), 0)
+        return fc.update_head()
+
+    # tied votes: without the boost the higher root bytes win the
+    # tie-break (the late block); the boost keeps the timely head
+    assert run(boost_enabled=False) == _root(2)
+    assert run(boost_enabled=True) == _root(1)
+
+
+# -- unrealized checkpoints (reference forkChoice.ts:406-453, onTick) --------
+
+def test_unrealized_justification_pulls_up_at_epoch_boundary():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    # block says: if its epoch ended now, epoch 1 would be justified
+    fc.update_time(9)  # slot 9 = epoch 1 (slots_per_epoch=8)
+    fc.on_block(
+        9, _root(2), _root(1), b"", (0, _root(0)), (0, _root(0)),
+        unrealized_justified_checkpoint=(1, _root(1)),
+        unrealized_finalized_checkpoint=(0, _root(0)),
+    )
+    assert fc.store.justified_checkpoint[0] == 0  # not yet realized
+    assert fc.store.unrealized_justified == (1, _root(1))
+    fc.update_time(16)  # epoch 2 boundary: pull up
+    assert fc.store.justified_checkpoint == (1, _root(1))
+
+
+def test_prior_epoch_block_pulls_up_immediately():
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.update_time(17)  # epoch 2
+    # import a block FROM epoch 1 (past epoch) whose unrealized view
+    # justifies epoch 1 — adopted right away (forkChoice.ts:445-453)
+    fc.on_block(
+        9, _root(2), _root(1), b"", (0, _root(0)), (0, _root(0)),
+        unrealized_justified_checkpoint=(1, _root(1)),
+        unrealized_finalized_checkpoint=(0, _root(0)),
+    )
+    assert fc.store.justified_checkpoint == (1, _root(1))
+
+
+def test_prev_epoch_tip_viable_via_unrealized_checkpoints():
+    """A tip from the previous epoch whose REALIZED justification lags but
+    whose unrealized justification matches the store must stay viable
+    (protoArray.ts:741-747)."""
+    fc = make_fc()
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    fc.update_time(9)
+    fc.on_block(
+        9, _root(2), _root(1), b"", (0, _root(0)), (0, _root(0)),
+        unrealized_justified_checkpoint=(1, _root(1)),
+    )
+    fc.update_time(16)  # pull-up realizes epoch-1 justification
+    assert fc.store.justified_checkpoint[0] == 1
+    # head walk from the justified root must still reach the tip whose
+    # node.justified_epoch is 0 but unrealized is 1
+    assert fc.update_head() == _root(2)
+
+
+def test_bouncing_attack_guard_defers_late_justification():
+    # minimal-preset-style window: only the first 2 slots of an epoch
+    # accept an immediate justified-checkpoint bump
+    fc = make_fc(safe_slots_to_update_justified=2)
+    fc.on_block(1, _root(1), _root(0), b"", (0, _root(0)), (0, _root(0)))
+    # move deep into an epoch (slot 14 = epoch 1 slot 6: past the window)
+    fc.update_time(14)
+    fc.on_block(
+        14, _root(2), _root(1), b"", (1, _root(1)), (0, _root(0)),
+    )
+    # justification arrives late in the epoch: held in best_justified
+    assert fc.store.justified_checkpoint[0] == 0
+    assert fc.store.best_justified == (1, _root(1))
+    fc.update_time(16)  # epoch boundary adopts it
+    assert fc.store.justified_checkpoint == (1, _root(1))
