@@ -167,18 +167,46 @@ class BeaconApiImpl:
         return None
 
     def submitPoolVoluntaryExit(self, params, query, body):
+        """Validated like the gossip path (round-1 advisor finding: an
+        unvalidated REST submission could poison the pool and invalidate
+        the next produced block; reference runs the same validation in
+        the pool API)."""
+        from ..chain.validation import GossipAction, validate_gossip_voluntary_exit
+
         exit_ = self.types.SignedVoluntaryExit.from_obj(body)
-        self.chain.op_pool.add_voluntary_exit(exit_)
+        result = validate_gossip_voluntary_exit(self.chain, self.types, exit_)
+        if result.action is GossipAction.REJECT:
+            raise ApiError(400, f"invalid voluntary exit: {result.reason}")
+        if result.action is GossipAction.ACCEPT:
+            self.chain.op_pool.add_voluntary_exit(exit_)
         return None
 
     def submitPoolProposerSlashings(self, params, query, body):
+        from ..chain.validation import (
+            GossipAction,
+            validate_gossip_proposer_slashing,
+        )
+
         slashing = self.types.ProposerSlashing.from_obj(body)
-        self.chain.op_pool.add_proposer_slashing(slashing)
+        result = validate_gossip_proposer_slashing(self.chain, self.types, slashing)
+        if result.action is GossipAction.REJECT:
+            raise ApiError(400, f"invalid proposer slashing: {result.reason}")
+        if result.action is GossipAction.ACCEPT:
+            self.chain.op_pool.add_proposer_slashing(slashing)
         return None
 
     def submitPoolAttesterSlashings(self, params, query, body):
+        from ..chain.validation import (
+            GossipAction,
+            validate_gossip_attester_slashing,
+        )
+
         slashing = self.types.AttesterSlashing.from_obj(body)
-        self.chain.op_pool.add_attester_slashing(slashing)
+        result = validate_gossip_attester_slashing(self.chain, self.types, slashing)
+        if result.action is GossipAction.REJECT:
+            raise ApiError(400, f"invalid attester slashing: {result.reason}")
+        if result.action is GossipAction.ACCEPT:
+            self.chain.op_pool.add_attester_slashing(slashing)
         return None
 
     def prepareBeaconProposer(self, params, query, body):

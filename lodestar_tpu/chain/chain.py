@@ -41,6 +41,8 @@ from .seen_cache import (
     SeenAggregators,
     SeenAttesters,
     SeenBlockProposers,
+    SeenContributionAndProof,
+    SeenSyncCommitteeMessages,
 )
 from .state_cache import CheckpointStateCache, StateContextCache
 
@@ -132,6 +134,8 @@ class BeaconChain:
         self.seen_aggregators = SeenAggregators()
         self.seen_block_proposers = SeenBlockProposers()
         self.seen_aggregated = SeenAggregatedAttestations()
+        self.seen_sync_committee = SeenSyncCommitteeMessages()
+        self.seen_contribution_and_proof = SeenContributionAndProof()
         self.blocks: dict[bytes, object] = {anchor_root: None}
         self.finalized_blocks: dict[bytes, object] = {}
 
@@ -347,6 +351,8 @@ class BeaconChain:
         self.aggregated_pool.prune(post.current_epoch)
         self.sync_committee_pool.prune(block.slot)
         self.sync_contribution_pool.prune(block.slot)
+        self.seen_sync_committee.prune(block.slot)
+        self.seen_contribution_and_proof.prune(block.slot)
         self.beacon_proposer_cache.prune(post.current_epoch)
 
     def _emit_light_client_updates(self) -> None:

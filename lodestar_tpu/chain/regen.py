@@ -21,6 +21,8 @@ class RegenError(ValueError):
 class StateRegenerator:
     def __init__(self, chain):
         self.chain = chain
+        # (parent_root, slot) → advanced pre-state; see get_pre_state
+        self._block_slot_cache: dict[tuple[bytes, int], object] = {}
 
     def get_state_by_root(self, state_root: bytes):
         cached = self.chain.state_cache.get(state_root)
@@ -67,11 +69,23 @@ class StateRegenerator:
 
     def get_pre_state(self, block) -> object:
         """Pre-state for a block: parent state advanced to the block's slot
-        (reference getPreState — the BlockProcessor entry point)."""
+        (reference getPreState — the BlockProcessor entry point).
+
+        A tiny (parent_root, slot) cache dedupes the advance between
+        gossip validation (proposer/signature checks) and the import that
+        follows moments later — the reference's getBlockSlotState role.
+        Callers must NOT mutate the returned state (import copies it)."""
+        key = (bytes(block.parent_root), int(block.slot))
+        cached = self._block_slot_cache.get(key)
+        if cached is not None:
+            return cached
         pre = self.get_state_for_block(bytes(block.parent_root))
         pre = pre.copy()
         if block.slot > pre.state.slot:
             process_slots(pre, self.chain.types, block.slot)
+        if len(self._block_slot_cache) >= 4:
+            self._block_slot_cache.pop(next(iter(self._block_slot_cache)))
+        self._block_slot_cache[key] = pre
         return pre
 
     def get_checkpoint_state(self, epoch: int, root: bytes):

@@ -83,3 +83,79 @@ class SeenAggregatedAttestations:
         for r in stale:
             self._by_root.pop(r, None)
             self._epoch_of_root.pop(r, None)
+
+
+class SeenSyncCommitteeMessages:
+    """First-seen per (slot, subnet, validator) — the [IGNORE] dedup of the
+    sync_committee_{subnet} topic (reference seenCache/seenCommittee.ts)."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self):
+        self._seen: set[tuple[int, int, int]] = set()
+
+    def is_known(self, slot: int, subnet: int, validator_index: int) -> bool:
+        return (slot, subnet, validator_index) in self._seen
+
+    def add(self, slot: int, subnet: int, validator_index: int) -> None:
+        self._seen.add((slot, subnet, validator_index))
+
+    def prune(self, clock_slot: int) -> None:
+        self._seen = {
+            k for k in self._seen if k[0] + self.SLOTS_RETAINED >= clock_slot
+        }
+
+
+class SeenContributionAndProof:
+    """Dedup for sync_committee_contribution_and_proof: first-seen per
+    aggregator (slot, subcommittee, aggregator_index) plus the non-strict
+    participant-superset check per (slot, root, subcommittee) (reference
+    seenCache/seenGossipBlockInput... seenContributionAndProof.ts
+    participantsKnown/isAggregatorKnown)."""
+
+    SLOTS_RETAINED = 3
+
+    def __init__(self):
+        self._aggregators: set[tuple[int, int, int]] = set()
+        self._participants: dict[tuple[int, bytes, int], list[list[bool]]] = {}
+
+    def is_aggregator_known(
+        self, slot: int, subcommittee: int, aggregator_index: int
+    ) -> bool:
+        return (slot, subcommittee, aggregator_index) in self._aggregators
+
+    def participants_known(self, contribution) -> bool:
+        """True when some already-seen contribution's bits are a non-strict
+        superset of this contribution's bits."""
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            int(contribution.subcommittee_index),
+        )
+        bits = list(contribution.aggregation_bits)
+        for seen in self._participants.get(key, []):
+            if all(s or not b for s, b in zip(seen, bits)):
+                return True
+        return False
+
+    def add(self, contribution_and_proof) -> None:
+        c = contribution_and_proof.contribution
+        self._aggregators.add(
+            (
+                int(c.slot),
+                int(c.subcommittee_index),
+                int(contribution_and_proof.aggregator_index),
+            )
+        )
+        key = (int(c.slot), bytes(c.beacon_block_root), int(c.subcommittee_index))
+        self._participants.setdefault(key, []).append(list(c.aggregation_bits))
+
+    def prune(self, clock_slot: int) -> None:
+        self._aggregators = {
+            k for k in self._aggregators if k[0] + self.SLOTS_RETAINED >= clock_slot
+        }
+        self._participants = {
+            k: v
+            for k, v in self._participants.items()
+            if k[0] + self.SLOTS_RETAINED >= clock_slot
+        }

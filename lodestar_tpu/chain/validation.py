@@ -32,6 +32,10 @@ class ValidationResult:
     reason: str = ""
     attesting_index: int | None = None
     data_root: bytes | None = None
+    # sync-committee messages: ALL positions the validator holds in the
+    # subcommittee (committees sample with replacement — one validator can
+    # own several bits)
+    positions: list[int] | None = None
 
 
 def validate_gossip_attestation(
@@ -173,16 +177,37 @@ def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
     if parent is not None and parent.slot >= block.slot:
         return ValidationResult(GossipAction.REJECT, "parent slot not lower")
 
-    # [REJECT] proposer signature
+    # [REJECT] block descends from the finalized checkpoint (block.ts: the
+    # current finalized block must be an ancestor of the new block)
+    fin_root = chain.finalized_checkpoint[1]
+    if fin_epoch > 0 and chain.fork_choice.get_ancestor(parent_root, fin_slot) != fin_root:
+        return ValidationResult(
+            GossipAction.REJECT, "not a descendant of finalized checkpoint"
+        )
+
+    # [REJECT] expected proposer + proposer signature, both against the
+    # state at (parent_root, block.slot) — the head state may sit on a
+    # different fork or epoch with a different shuffling (round-1 advisor
+    # finding; reference block.ts verifies against getBlockSlotState)
     from ..state_transition.signature_sets import block_proposer_signature_set
 
     try:
-        head_state = chain.head_state
-        sig_set = block_proposer_signature_set(head_state, signed_block)
+        state = chain.regen.get_pre_state(block)
+    except Exception:
+        return ValidationResult(GossipAction.IGNORE, "cannot regen parent state")
+    if state.epoch_ctx.get_beacon_proposer(block.slot) != int(block.proposer_index):
+        return ValidationResult(GossipAction.REJECT, "wrong proposer")
+    try:
+        sig_set = block_proposer_signature_set(state, signed_block)
         if not chain.bls.verify_signature_sets([sig_set]):
             return ValidationResult(GossipAction.REJECT, "invalid proposer signature")
     except Exception:
         return ValidationResult(GossipAction.IGNORE, "cannot build signature set")
+
+    # re-check the proposal dedup after the (possibly awaited) signature
+    # verification — a concurrent duplicate must not be double-forwarded
+    if chain.seen_block_proposers.is_known(block.slot, block.proposer_index):
+        return ValidationResult(GossipAction.IGNORE, "duplicate proposal (post-verify)")
 
     return ValidationResult(GossipAction.ACCEPT)
 
@@ -282,6 +307,11 @@ def validate_gossip_aggregate_and_proof(chain, types, signed_agg) -> ValidationR
     if not chain.bls.verify_signature_sets([sel_set, env_set, att_set]):
         return ValidationResult(GossipAction.REJECT, "invalid signatures")
 
+    # re-check after the (batched, possibly awaited) verification so a
+    # concurrent duplicate is not double-forwarded (reference
+    # aggregateAndProof.ts post-verify re-check; round-1 advisor finding)
+    if chain.seen_aggregators.is_known(target_epoch, aggregator_index):
+        return ValidationResult(GossipAction.IGNORE, "aggregator seen (post-verify)")
     chain.seen_aggregators.add(target_epoch, aggregator_index)
     chain.seen_aggregated.add(target_epoch, data_root, bits)
     return ValidationResult(GossipAction.ACCEPT, data_root=data_root)
@@ -364,4 +394,182 @@ def validate_gossip_attester_slashing(chain, types, slashing) -> ValidationResul
         attester_slashing_signature_sets(head, slashing)
     ):
         return ValidationResult(GossipAction.REJECT, "invalid signature")
+    return ValidationResult(GossipAction.ACCEPT)
+
+
+# --- sync-committee topic ladders -------------------------------------------
+#
+# Reference: chain/validation/syncCommittee.ts (message ladder) and
+# syncCommitteeContributionAndProof.ts (contribution ladder). Both route
+# their signature sets through the chain's batchable verifier like
+# attestations.
+
+def _sync_subcommittee(chain, subcommittee_index: int) -> tuple[list[int], list[bytes]]:
+    """(validator indices, pubkeys) of the given subcommittee slice of the
+    CURRENT sync committee, cached per sync period (the committee only
+    rotates every EPOCHS_PER_SYNC_COMMITTEE_PERIOD epochs — reference
+    caches an indexed committee on the epoch context,
+    epochCtx.getIndexedSyncCommittee)."""
+    cached = chain.head_state
+    p = chain.preset
+    period = cached.epoch_ctx.current_epoch // p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    cache = getattr(chain, "_sync_subcommittee_cache", None)
+    if cache is None:
+        cache = chain._sync_subcommittee_cache = {}
+    hit = cache.get((period, subcommittee_index))
+    if hit is not None:
+        return hit
+    state = cached.state
+    size = p.SYNC_COMMITTEE_SUBNET_SIZE
+    start = subcommittee_index * size
+    pk_to_idx = cached.epoch_ctx.pubkey_to_index
+    pubkeys = [
+        bytes(pk)
+        for pk in list(state.current_sync_committee.pubkeys)[start : start + size]
+    ]
+    members = [pk_to_idx.get(pk, -1) for pk in pubkeys]
+    if len(cache) > 16:
+        # evict stale periods only — the current period's entries stay hot
+        for k in [k for k in cache if k[0] != period]:
+            del cache[k]
+    cache[(period, subcommittee_index)] = (members, pubkeys)
+    return members, pubkeys
+
+
+def _sync_subcommittee_members(chain, subcommittee_index: int) -> list[int]:
+    return _sync_subcommittee(chain, subcommittee_index)[0]
+
+
+def is_sync_committee_aggregator(selection_proof: bytes, p) -> bool:
+    """spec is_sync_committee_aggregator: hash(proof)[:8] little-endian mod
+    max(1, subcommittee_size // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)."""
+    from ..params import TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE
+    from ..ssz.hashing import sha256
+
+    modulo = max(
+        1, p.SYNC_COMMITTEE_SUBNET_SIZE // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE
+    )
+    return int.from_bytes(sha256(bytes(selection_proof))[:8], "little") % modulo == 0
+
+
+def validate_gossip_sync_committee(
+    chain, types, msg, subnet: int
+) -> ValidationResult:
+    """The sync_committee_{subnet} ladder (syncCommittee.ts ordering)."""
+    from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+    from ..state_transition.signature_sets import sync_committee_message_signature_set
+
+    # [IGNORE] message slot is the current slot (gossip clock disparity)
+    if not chain.clock.is_current_slot_given_disparity(msg.slot):
+        return ValidationResult(GossipAction.IGNORE, "not current slot")
+
+    # [REJECT] subnet id in range
+    if subnet >= SYNC_COMMITTEE_SUBNET_COUNT:
+        return ValidationResult(GossipAction.REJECT, "invalid subcommittee index")
+
+    # [REJECT] the validator belongs to the declared subcommittee
+    members = _sync_subcommittee_members(chain, subnet)
+    if int(msg.validator_index) not in members:
+        return ValidationResult(
+            GossipAction.REJECT, "validator not in sync subcommittee"
+        )
+
+    # [IGNORE] first message for (slot, subnet, validator)
+    if chain.seen_sync_committee.is_known(
+        int(msg.slot), subnet, int(msg.validator_index)
+    ):
+        return ValidationResult(GossipAction.IGNORE, "already seen")
+
+    # [REJECT] signature over beacon_block_root
+    sig_set = sync_committee_message_signature_set(chain.head_state, msg)
+    if not chain.bls.verify_signature_sets([sig_set]):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+
+    # re-check the seen cache after the (possibly batched/awaited)
+    # signature verification, as attestation validation does
+    if chain.seen_sync_committee.is_known(
+        int(msg.slot), subnet, int(msg.validator_index)
+    ):
+        return ValidationResult(GossipAction.IGNORE, "already seen (post-verify)")
+    chain.seen_sync_committee.add(int(msg.slot), subnet, int(msg.validator_index))
+    # committees sample with replacement: report EVERY position this
+    # validator holds in the subcommittee — the pool must set all its
+    # bits from this one (first-seen-deduped) message
+    positions = [i for i, v in enumerate(members) if v == int(msg.validator_index)]
+    return ValidationResult(
+        GossipAction.ACCEPT,
+        attesting_index=positions[0],
+        positions=positions,
+    )
+
+
+def validate_gossip_sync_contribution_and_proof(
+    chain, types, signed
+) -> ValidationResult:
+    """The sync_committee_contribution_and_proof ladder
+    (syncCommitteeContributionAndProof.ts ordering)."""
+    from ..params import SYNC_COMMITTEE_SUBNET_COUNT
+    from ..state_transition.signature_sets import (
+        contribution_and_proof_signature_set,
+        sync_contribution_signature_set,
+        sync_selection_proof_signature_set,
+    )
+
+    cap = signed.message
+    contribution = cap.contribution
+    slot = int(contribution.slot)
+    subcommittee = int(contribution.subcommittee_index)
+    aggregator = int(cap.aggregator_index)
+
+    # [IGNORE] contribution slot is the current slot
+    if not chain.clock.is_current_slot_given_disparity(slot):
+        return ValidationResult(GossipAction.IGNORE, "not current slot")
+
+    # [REJECT] subcommittee index in range
+    if subcommittee >= SYNC_COMMITTEE_SUBNET_COUNT:
+        return ValidationResult(GossipAction.REJECT, "invalid subcommittee index")
+
+    # [REJECT] aggregator is a member of the declared subcommittee
+    members, subcommittee_pubkeys = _sync_subcommittee(chain, subcommittee)
+    if aggregator not in members:
+        return ValidationResult(
+            GossipAction.REJECT, "aggregator not in sync subcommittee"
+        )
+
+    # [IGNORE] participants are a non-strict subset of an already-seen one
+    if chain.seen_contribution_and_proof.participants_known(contribution):
+        return ValidationResult(GossipAction.IGNORE, "participants already known")
+
+    # [IGNORE] first contribution from this aggregator for (slot, subcommittee)
+    if chain.seen_contribution_and_proof.is_aggregator_known(
+        slot, subcommittee, aggregator
+    ):
+        return ValidationResult(GossipAction.IGNORE, "aggregator already seen")
+
+    # [REJECT] the contribution has participants
+    bits = list(contribution.aggregation_bits)
+    participant_pubkeys = [pk for pk, b in zip(subcommittee_pubkeys, bits) if b]
+    if not participant_pubkeys:
+        return ValidationResult(GossipAction.REJECT, "no participants")
+
+    # [REJECT] selection proof selects the aggregator
+    if not is_sync_committee_aggregator(cap.selection_proof, chain.preset):
+        return ValidationResult(GossipAction.REJECT, "not an aggregator")
+
+    # [REJECT] all three signatures, batched through the verifier:
+    # selection proof, contribution-and-proof envelope, and the aggregate
+    cached = chain.head_state
+    sets = [
+        sync_selection_proof_signature_set(cached, types, cap),
+        contribution_and_proof_signature_set(cached, signed),
+        sync_contribution_signature_set(cached, contribution, participant_pubkeys),
+    ]
+    if not chain.bls.verify_signature_sets(sets):
+        return ValidationResult(GossipAction.REJECT, "invalid signature")
+
+    if chain.seen_contribution_and_proof.is_aggregator_known(
+        slot, subcommittee, aggregator
+    ):
+        return ValidationResult(GossipAction.IGNORE, "aggregator seen (post-verify)")
+    chain.seen_contribution_and_proof.add(cap)
     return ValidationResult(GossipAction.ACCEPT)

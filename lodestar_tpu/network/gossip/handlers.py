@@ -171,19 +171,37 @@ class GossipHandlers:
             if not hasattr(types, "SyncCommitteeMessage"):
                 return ValidationResult.IGNORE
             msg = types.SyncCommitteeMessage.deserialize(ssz)
-            pool = getattr(chain, "sync_committee_pool", None)
-            if pool is not None and topic.subnet is not None:
-                pool.add(msg, topic.subnet, 0)
-            return ValidationResult.ACCEPT
+            from ...chain.validation import validate_gossip_sync_committee
+
+            result = validate_gossip_sync_committee(
+                chain, types, msg, topic.subnet if topic.subnet is not None else 0
+            )
+            if result.action is GossipAction.ACCEPT:
+                pool = getattr(chain, "sync_committee_pool", None)
+                if pool is not None and topic.subnet is not None:
+                    # a validator can hold several positions in one
+                    # subcommittee (sampling with replacement): set all
+                    # of its bits from this first-seen message
+                    for pos in result.positions or [result.attesting_index or 0]:
+                        pool.add(msg, topic.subnet, pos)
+            return _ACTION_TO_RESULT[result.action]
 
         if t is GossipType.sync_committee_contribution_and_proof:
             if not hasattr(types, "SignedContributionAndProof"):
                 return ValidationResult.IGNORE
             signed = types.SignedContributionAndProof.deserialize(ssz)
-            pool = getattr(chain, "sync_contribution_pool", None)
-            if pool is not None:
-                pool.add(signed.message.contribution)
-            return ValidationResult.ACCEPT
+            from ...chain.validation import (
+                validate_gossip_sync_contribution_and_proof,
+            )
+
+            result = validate_gossip_sync_contribution_and_proof(
+                chain, types, signed
+            )
+            if result.action is GossipAction.ACCEPT:
+                pool = getattr(chain, "sync_contribution_pool", None)
+                if pool is not None:
+                    pool.add(signed.message.contribution)
+            return _ACTION_TO_RESULT[result.action]
 
         # light-client updates: served, not consumed, by full nodes
         return ValidationResult.IGNORE

@@ -48,8 +48,15 @@ def parse_topic(s: str) -> GossipTopic:
         raise ValueError(f"malformed gossip topic: {s}")
     fork_digest = bytes.fromhex(parts[2])
     name = parts[3]
+    # exact names FIRST: "sync_committee_contribution_and_proof" starts
+    # with the "sync_committee_" subnet prefix and must not be parsed as
+    # a subnet topic (round-2 regression found driving the wire path)
+    try:
+        return GossipTopic(GossipType(name), fork_digest, None)
+    except ValueError:
+        pass
     for t in SUBNET_TYPES:
         prefix = t.value + "_"
         if name.startswith(prefix):
             return GossipTopic(t, fork_digest, int(name[len(prefix):]))
-    return GossipTopic(GossipType(name), fork_digest, None)
+    raise ValueError(f"unknown gossip topic name: {name}")

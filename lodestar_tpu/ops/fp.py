@@ -8,13 +8,19 @@ safe to `vmap`/`shard_map`.
 Design notes (why this maps well to TPU):
 - All hot paths are fixed-trip `lax.scan`s or statically unrolled loops:
   no data-dependent control flow, so XLA compiles one fused kernel.
-- The schoolbook product is 32 vector multiply-adds on the VPU; the
-  Montgomery reduction is a 32-step scan whose body is one vector
-  multiply-add — sequential over limbs, parallel over the batch, which is
-  where the throughput comes from (BASELINE.json wants batched signature
-  sets, not single-signature latency).
+- The default TPU multiply maps the limb convolution onto the MXU
+  (`conv` + `_mul_fused`): one packed (3B,1024)@(1024,64) bf16 matmul
+  per convolution, full-width Montgomery reduction, carries as short
+  scans. CPU keeps the word-serial scan multiply.
 - Values range over [0, 2p) between ops (lazy reduction); every op's
   output respects that invariant, and `canonical` gives the < p form.
+- COMPILE-SIZE DISCIPLINE (round-2 lesson): a full verifier kernel
+  traces ~1500 carry sites. Carries must stay graph-light — the
+  `carry_scan` form costs ~5 jaxpr eqns/site vs ~300 for the unrolled
+  Kogge-Stone (`ks_carry`), which inflated the kernel to 650k eqns and
+  >50 min XLA compiles. Runtime at production widths is carry-neutral
+  (BASELINE.md: 96.6 vs 95.1 ms per 100 chained muls), so the scans
+  stay; `ks_carry` remains available for experiments.
 
 Oracle: `lodestar_tpu/bls/fields.Fq` (differential tests in
 tests/test_ops_fp.py).
@@ -47,11 +53,13 @@ _ONE_MONT = jnp.asarray(ONE_MONT_LIMBS)
 
 
 def carry_scan(t: jnp.ndarray) -> jnp.ndarray:
-    """Sequential carry propagation (reference implementation).
+    """Exact carry/borrow propagation -> canonical 12-bit limbs.
 
-    Kept as the differential oracle for `ks_carry` and for ad-hoc use; hot
-    paths use the log-depth `ks_carry` instead — a 32/64-step `lax.scan`
-    of tiny steps is pure dispatch latency on TPU.
+    Works for signed inputs: `>>` is arithmetic shift and `& MASK` is the
+    positive remainder, so borrows ripple as negative carries. The final
+    carry out of the top limb is dropped (callers guarantee the value fits
+    384 bits and is non-negative). One `lax.scan` eqn in the graph — the
+    graph-light workhorse behind every add/sub/mul (see module docstring).
     """
     tt = jnp.moveaxis(t, -1, 0)
 
@@ -68,8 +76,7 @@ def _ks_carry_impl(t: jnp.ndarray):
 
     Accepts signed columns with |t| < 2^30 whose VALUE (Σ t_i·2^(12i)) is
     non-negative; returns limbs in [0, 2^12) plus the unmasked top residue
-    `out` (what carries past the last column — callers append a zero column
-    when they need it, or rely on the value fitting to drop it).
+    `out` (what carries past the last column).
 
     Structure (everything fuses — no lax.scan, no sequential chain):
       1. three shift-folds with arithmetic shifts: digits land in [-1, 2^12]
@@ -77,6 +84,11 @@ def _ks_carry_impl(t: jnp.ndarray):
       2. the residual ±1 carry chain is a Kogge–Stone prefix over monotone
          carry maps {-1,0,1}→{-1,0,1}, each map encoded by its three
          outputs; composition is 3 selects, ⌈log2(K)⌉ rounds.
+
+    NOT used on the default paths: it emits ~300 jaxpr eqns per site and
+    measured runtime-neutral vs `carry_scan` at production widths — see
+    the module docstring's compile-size note. Kept as an experiment and
+    differentially pinned against `carry_scan`.
     """
     k = t.shape[-1]
 
@@ -119,42 +131,36 @@ def _ks_carry_impl(t: jnp.ndarray):
 def ks_carry(t: jnp.ndarray) -> jnp.ndarray:
     """Log-depth carry propagation; drops the out-carry (callers guarantee
     the non-negative value fits the column count). Contract of
-    `carry_scan`, fused implementation."""
+    `carry_scan`, fused implementation. Experimental — see module
+    docstring."""
     digits, _ = _ks_carry_impl(t)
     return digits
 
 
-def _carry_out(t: jnp.ndarray):
-    """ks_carry + the value carried past the top column (appends a zero
-    column so fold carries are captured, not dropped). The extension
-    column is masked like every limb, so the out value is only exact for
-    carries < 2^12 — ample for the complement-add use (carry ∈ {0,1})."""
-    ext = jnp.concatenate([t, jnp.zeros_like(t[..., :1])], axis=-1)
-    digits, _ = _ks_carry_impl(ext)
-    return digits[..., :-1], digits[..., -1]
+def _lex_ge(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """a >= m comparing canonical limb vectors (trailing limb axis)."""
+    diff = a - m
+    nz = diff != 0
+    pos = diff > 0
+    rev_nz = jnp.flip(nz, axis=-1)
+    first = jnp.argmax(rev_nz, axis=-1)  # index (from top) of highest nonzero
+    idx = (N_LIMBS - 1 - first)[..., None]
+    top_sign = jnp.take_along_axis(pos, idx, axis=-1)[..., 0]
+    return jnp.where(nz.any(axis=-1), top_sign, True)
 
 
-def _cond_sub(a: jnp.ndarray, comp_m: jnp.ndarray) -> jnp.ndarray:
-    """a - m if a >= m else a, with comp_m = 2^384 - m precomputed.
-
-    Complement-add: y = a + (2^384 - m) overflows bit 384 exactly when
-    a >= m, and then the truncated y IS a - m. One fused carry + select —
-    no lexicographic compare, no borrow chain.
-    """
-    y, out = _carry_out(a + comp_m)
-    return jnp.where(out[..., None] > 0, y, a)
-
-
-_COMP_TWO_P = jnp.asarray(int_to_limbs((1 << 384) - 2 * _P_INT))
-_COMP_P = jnp.asarray(int_to_limbs((1 << 384) - _P_INT))
+def _cond_sub(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """a - m if a >= m else a; a canonical, result canonical."""
+    ge = _lex_ge(a, m)
+    return carry_scan(a - jnp.where(ge[..., None], m, 0))
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _cond_sub(ks_carry(a + b), _COMP_TWO_P)
+    return _cond_sub(carry_scan(a + b), _TWO_P)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _cond_sub(ks_carry(a - b + _TWO_P), _COMP_TWO_P)
+    return _cond_sub(carry_scan(a - b + _TWO_P), _TWO_P)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
@@ -182,38 +188,34 @@ _S = jnp.asarray(_conv_matrix())
 
 
 def conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Column convolution of 12-bit limb vectors via a fixed MXU matmul.
+    """Column convolution of 12-bit limb vectors via ONE fixed MXU matmul.
 
     a, b: (..., N) canonical 12-bit limbs → (..., 2N) int32 columns.
     The ≤2^24 products are split into three 8-bit parts: each part is
     ≤ 255, EXACT in bf16 (8-bit mantissa), so the TPU's DEFAULT-precision
     single-pass matmul is bit-exact — parts × 0/1 entries accumulate in
-    f32 with partial sums ≤ 32·2^8 ≪ 2^24. Measured (BASELINE.md): three
-    one-pass matmuls beat two six-pass HIGHEST ones and the VPU scan.
+    f32 with partial sums ≤ 32·2^8 ≪ 2^24. The parts ride a new leading
+    axis through a single packed matmul (one dispatch, one HLO) and are
+    recombined with shifts. Measured (BASELINE.md): the 8-bit-split
+    DEFAULT-precision form beats both the 6-pass HIGHEST form and the
+    VPU scan path.
     """
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (N_LIMBS,))
     b = jnp.broadcast_to(b, batch + (N_LIMBS,))
     outer = (a[..., :, None] * b[..., None, :]).reshape(batch + (N_LIMBS * N_LIMBS,))
-    p0 = (outer & 0xFF).astype(jnp.float32)
-    p1 = ((outer >> 8) & 0xFF).astype(jnp.float32)
-    p2 = (outer >> 16).astype(jnp.float32)
-    c0 = jnp.matmul(p0, _S, preferred_element_type=jnp.float32)
-    c1 = jnp.matmul(p1, _S, preferred_element_type=jnp.float32)
-    c2 = jnp.matmul(p2, _S, preferred_element_type=jnp.float32)
-    return (
-        c0.astype(jnp.int32)
-        + (c1.astype(jnp.int32) << 8)
-        + (c2.astype(jnp.int32) << 16)
-    )
+    parts = jnp.stack(
+        [outer & 0xFF, (outer >> 8) & 0xFF, outer >> 16], axis=0
+    ).astype(jnp.float32)
+    c = jnp.matmul(parts, _S, preferred_element_type=jnp.float32).astype(jnp.int32)
+    return c[0] + (c[1] << 8) + (c[2] << 16)
 
 
 def _mul_scan(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Round-1 word-serial Montgomery multiply (32-step REDC scan).
+    """Word-serial Montgomery multiply (32-step REDC scan).
 
-    Kept as a differential reference and LODESTAR_TPU_LEGACY_FP=1 fallback;
-    superseded by `_mul_fused` — the scan's 32 sequential steps are
-    dispatch latency the fused path eliminates.
+    The CPU-backend default and LODESTAR_TPU_LEGACY_FP=1 fallback; the
+    TPU default is `_mul_fused`.
     """
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (N_LIMBS,))
@@ -236,25 +238,22 @@ def _mul_scan(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mul_fused(a: jnp.ndarray, b: jnp.ndarray, carry=None) -> jnp.ndarray:
-    """Fused Montgomery multiply: MXU convolutions + full-width REDC +
-    log-depth carries — zero `lax.scan`s, so whole tower operations
-    compile into a handful of fused kernels instead of hundreds of
-    sequential scan steps.
+    """Fused Montgomery multiply: MXU convolutions + full-width REDC.
 
-        t = a·b            (conv as three exact bf16 matmuls)
+        t = a·b            (conv as one packed bf16 matmul)
         m = (t mod R)·N' mod R
         out = (t + m·p) / R
 
     `carry` parameterizes the carry-propagation strategy (default
-    `ks_carry`; `mxu_fp.mul` passes its generate/propagate variant) so
-    the consensus-critical REDC pipeline exists exactly once.
+    `carry_scan` — graph-light; `mxu_fp.mul` passes its Kogge–Stone
+    variant) so the consensus-critical REDC pipeline exists exactly once.
 
-    Bounds: conv columns < 2^29, t+u columns < 2^30 (ks_carry's limit);
-    output < 2p for inputs < 2p: t < (2p)² so t/R < 4p²/R < p
-    (R = 2^384 > 4p); m·p/R < p; result < 2p.
+    Bounds: conv columns < 2^29, t+u columns < 2^30; output < 2p for
+    inputs < 2p: t < (2p)² so t/R < 4p²/R < p (R = 2^384 > 4p);
+    m·p/R < p; result < 2p.
     """
     if carry is None:
-        carry = ks_carry
+        carry = carry_scan
     t_cols = conv(a, b)
     t = carry(t_cols)  # (2p)² < 2^768 fits 64 limbs: no out-carry
     m_cols = conv(t[..., :N_LIMBS], _NPRIME)[..., :N_LIMBS]
@@ -269,34 +268,33 @@ _DEFAULT_IMPL = None
 
 
 def _default_impl():
-    """Pick the default multiply once per process.
+    """Pick the default multiply once per process: the word-serial scan.
 
-    TPU: `_mul_fused` — the MXU convolution + full-width REDC design
-    (BASELINE.md measured it ahead of the scan path on v5e). Other
-    backends (CPU tests / virtual mesh): the word-serial scan — the
-    (B,1024)@(1024,64) constant matmuls that feed the MXU are a large
-    compile-time and runtime pessimization on the CPU backend. Both
-    paths are differentially pinned against the big-int oracle either
-    way (tests/test_ops_fp.py).
+    Round-2 measurement (v5e, tools/kernel_probe.py): `_mul_fused` wins
+    microbenchmarks (21.9 vs 32.9 ms per 100 chained muls @4096) but
+    LOSES the full verifier kernel 13.4 s vs 5.2 s — the XLA matmul
+    cannot fuse its producer, so every conv materializes the 32×-blowup
+    outer product ((3,·,1024) f32, gigabytes per stacked tower mul) and
+    the kernel goes HBM-bandwidth-bound. The MXU design only pays off
+    VMEM-resident (Pallas — `ops/pallas_fp.py`); until that carries the
+    tower, the scan path is the default everywhere.
     """
     global _DEFAULT_IMPL
     if _DEFAULT_IMPL is None:
-        import jax
-
-        _DEFAULT_IMPL = _mul_fused if jax.default_backend() == "tpu" else _mul_scan
+        _DEFAULT_IMPL = _mul_scan
     return _DEFAULT_IMPL
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product REDC(a*b): inputs < 2p, output < 2p.
 
-    Default path on TPU is `_mul_fused` (MXU convolution + full-width
-    REDC); on other backends the word-serial scan (see `_default_impl`).
+    Default path is the word-serial scan on every backend — see
+    `_default_impl` for the measurement that demoted the MXU path.
     Env overrides: LODESTAR_TPU_PALLAS_MUL=1 routes through the Pallas
     VMEM-resident kernel (`ops/pallas_fp.py`); LODESTAR_TPU_LEGACY_FP=1
-    forces the round-1 word-serial scan; LODESTAR_TPU_MXU_MUL=1 (round
-    1's opt-in flag for the then-experimental MXU path) forces the
-    `mxu_fp.mul` carry variant on any backend.
+    forces the word-serial scan explicitly; LODESTAR_TPU_MXU_MUL=1
+    (round 1's opt-in flag) forces the `mxu_fp.mul` MXU/Kogge–Stone
+    variant.
     """
     import os
 
@@ -325,12 +323,12 @@ def to_mont(a: jnp.ndarray) -> jnp.ndarray:
 def from_mont(a: jnp.ndarray) -> jnp.ndarray:
     """Montgomery form -> canonical normal-domain limbs (< p)."""
     one = jnp.zeros(N_LIMBS, jnp.int32).at[0].set(1)
-    return _cond_sub(mul(a, one), _COMP_P)
+    return _cond_sub(mul(a, one), _P)
 
 
 def canonical(a: jnp.ndarray) -> jnp.ndarray:
     """Reduce the [0, 2p) representative to the unique [0, p) form."""
-    return _cond_sub(a, _COMP_P)
+    return _cond_sub(a, _P)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
@@ -389,4 +387,3 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
 def sqrt_candidate(a: jnp.ndarray) -> jnp.ndarray:
     """a^((p+1)/4) — a square root iff a is a QR (p ≡ 3 mod 4)."""
     return pow_const(a, (_P_INT + 1) // 4)
-

@@ -195,3 +195,91 @@ def bls_to_execution_change_signature_set(cached, signed_change) -> bls.Signatur
         ),
         signature=bytes(signed_change.signature),
     )
+
+
+# --- sync-committee gossip signature sets (validation/signatureSets/) -------
+
+def sync_committee_message_signature_set(cached, msg) -> bls.SignatureSet:
+    """DOMAIN_SYNC_COMMITTEE over the message's beacon_block_root, signed
+    by the referenced validator (reference
+    validation/signatureSets/syncCommittee.ts:10)."""
+    from ..params import DOMAIN_SYNC_COMMITTEE
+
+    p = cached.preset
+    domain = cached.config.get_domain(
+        DOMAIN_SYNC_COMMITTEE,
+        msg.slot,
+        util.compute_epoch_at_slot(msg.slot, p.SLOTS_PER_EPOCH),
+    )
+    return bls.SignatureSet(
+        pubkey=_pk(cached, msg.validator_index),
+        message=compute_signing_root(bytes(msg.beacon_block_root), domain),
+        signature=bytes(msg.signature),
+    )
+
+
+def sync_selection_proof_signature_set(cached, types, contribution_and_proof):
+    """DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF over SyncAggregatorSelectionData
+    (reference signatureSets/syncCommitteeSelectionProof.ts)."""
+    from ..params import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+
+    p = cached.preset
+    c = contribution_and_proof.contribution
+    domain = cached.config.get_domain(
+        DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        c.slot,
+        util.compute_epoch_at_slot(c.slot, p.SLOTS_PER_EPOCH),
+    )
+    selection_data = types.SyncAggregatorSelectionData(
+        slot=c.slot, subcommittee_index=c.subcommittee_index
+    )
+    return bls.SignatureSet(
+        pubkey=_pk(cached, contribution_and_proof.aggregator_index),
+        message=compute_signing_root(selection_data.hash_tree_root(), domain),
+        signature=bytes(contribution_and_proof.selection_proof),
+    )
+
+
+def contribution_and_proof_signature_set(cached, signed) -> bls.SignatureSet:
+    """DOMAIN_CONTRIBUTION_AND_PROOF over the ContributionAndProof container
+    (reference signatureSets/contributionAndProof.ts:10)."""
+    from ..params import DOMAIN_CONTRIBUTION_AND_PROOF
+
+    p = cached.preset
+    slot = signed.message.contribution.slot
+    domain = cached.config.get_domain(
+        DOMAIN_CONTRIBUTION_AND_PROOF,
+        slot,
+        util.compute_epoch_at_slot(slot, p.SLOTS_PER_EPOCH),
+    )
+    return bls.SignatureSet(
+        pubkey=_pk(cached, signed.message.aggregator_index),
+        message=compute_signing_root(signed.message.hash_tree_root(), domain),
+        signature=bytes(signed.signature),
+    )
+
+
+def sync_contribution_signature_set(
+    cached, contribution, participant_pubkeys: list[bytes]
+) -> bls.SignatureSet:
+    """DOMAIN_SYNC_COMMITTEE over the contribution's beacon_block_root with
+    the aggregate of the participant pubkeys (reference
+    signatureSets/syncCommitteeContribution.ts:6)."""
+    from ..params import DOMAIN_SYNC_COMMITTEE
+
+    p = cached.preset
+    domain = cached.config.get_domain(
+        DOMAIN_SYNC_COMMITTEE,
+        contribution.slot,
+        util.compute_epoch_at_slot(contribution.slot, p.SLOTS_PER_EPOCH),
+    )
+    agg = bls.aggregate_pubkeys(
+        [bls.PublicKey.from_bytes(pk, validate=False) for pk in participant_pubkeys]
+    )
+    return bls.SignatureSet(
+        pubkey=agg,
+        message=compute_signing_root(
+            bytes(contribution.beacon_block_root), domain
+        ),
+        signature=bytes(contribution.signature),
+    )

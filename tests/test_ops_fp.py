@@ -129,14 +129,38 @@ def test_canonical_at_modulus_boundary():
 
 def test_mul_all_impls_against_oracle():
     """Every multiply implementation — including the exact shipped TPU
-    default combination (_mul_fused + ks_carry) that CPU runs would
-    otherwise never exercise — must match the big-int oracle."""
+    MXU/fused pipeline and both experimental carry variants (none of
+    which are the shipped default — the scan multiply is, see
+    fp._default_impl) — must match the big-int oracle."""
     from lodestar_tpu.ops import mxu_fp
 
     xs = [0, 1, P - 1, P - 2] + [rand_fp() for _ in range(8)]
     ys = [P - 1, 0, P - 1, 2] + [rand_fp() for _ in range(8)]
     a, b = to_dev(xs), to_dev(ys)
     ref = [(x * y) % P for x, y in zip(xs, ys)]
-    assert from_dev(jax.jit(fp._mul_fused)(a, b)) == ref  # TPU default
-    assert from_dev(jax.jit(fp._mul_scan)(a, b)) == ref   # CPU default
+    assert from_dev(jax.jit(fp._mul_scan)(a, b)) == ref   # the default
+    assert from_dev(jax.jit(fp._mul_fused)(a, b)) == ref  # MXU pipeline
     assert from_dev(jax.jit(mxu_fp.mul)(a, b)) == ref     # g/p-carry variant
+    fused_ks = jax.jit(lambda x, y: fp._mul_fused(x, y, carry=fp.ks_carry))
+    assert from_dev(fused_ks(a, b)) == ref                # signed-KS variant
+
+
+def test_ks_carry_matches_carry_scan():
+    """The experimental log-depth carry must agree with the scan reference
+    on large positive columns and on signed columns (borrows)."""
+    rng2 = random.Random(77)
+    rows = []
+    for _ in range(8):
+        # big uncarried columns (like conv outputs): value stays < 2^768
+        rows.append([rng2.randrange(0, 1 << 28) for _ in range(63)] + [0])
+    for _ in range(8):
+        # signed columns with borrows: x - y + 2^760 with x, y < 2^756
+        x = rng2.randrange(1 << 756)
+        y = rng2.randrange(1 << 756)
+        cols = [((x >> (12 * k)) & 0xFFF) - ((y >> (12 * k)) & 0xFFF) for k in range(64)]
+        cols[63] += 1 << (760 - 12 * 63)  # keep the value non-negative
+        rows.append(cols)
+    cols = np.asarray(rows, np.int32)
+    got_ks = np.asarray(jax.jit(fp.ks_carry)(jnp.asarray(cols)))
+    got_scan = np.asarray(jax.jit(fp.carry_scan)(jnp.asarray(cols)))
+    assert np.array_equal(got_ks, got_scan)

@@ -1,0 +1,256 @@
+"""Sync-committee gossip validation ladders (VERDICT round-1 missing #3).
+
+Reference: chain/validation/syncCommittee.ts:1-80 (message ladder) and
+syncCommitteeContributionAndProof.ts (contribution ladder). These are
+live-chain tests in the style of test_network_gossip.py: real minimal-preset
+altair chain, real BLS signatures, invalid variants must be REJECTed and
+duplicates IGNOREd.
+"""
+
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.chain import BeaconChain
+from lodestar_tpu.chain.validation import (
+    GossipAction,
+    _sync_subcommittee_members,
+    is_sync_committee_aggregator,
+    validate_gossip_sync_committee,
+    validate_gossip_sync_contribution_and_proof,
+)
+from lodestar_tpu.config.beacon_config import (
+    BeaconConfig,
+    ChainForkConfig,
+    compute_signing_root,
+)
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params import (
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+)
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
+from lodestar_tpu.types import get_types
+
+SPE = MINIMAL.SLOTS_PER_EPOCH
+SUBNET_SIZE = MINIMAL.SYNC_COMMITTEE_SUBNET_SIZE
+
+
+def _sk(i):
+    return bls.interop_secret_key(i)
+
+
+@pytest.fixture(scope="module")
+def chain_setup():
+    t = get_types(MINIMAL)
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    pre = interop_genesis_state(fork_config, t.phase0, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(pre.genesis_validators_root), MINIMAL
+    )
+    state = upgrade_state_to_altair(config, MINIMAL, pre, t.altair)
+    chain = BeaconChain(config, t.altair, state)
+    chain.clock.set_slot(1)
+    # move 1s INTO slot 1: at the exact boundary the previous slot is
+    # still current within MAXIMUM_GOSSIP_CLOCK_DISPARITY
+    chain.clock._now += 1.0
+    return config, t.altair, chain
+
+
+def _make_message(config, chain, subnet=0, position=0, flip_sig=False, slot=1):
+    members = _sync_subcommittee_members(chain, subnet)
+    validator_index = members[position]
+    domain = config.get_domain(DOMAIN_SYNC_COMMITTEE, slot, slot // SPE)
+    root = compute_signing_root(chain.head_root, domain)
+    sk = _sk(validator_index + (99 if flip_sig else 0))
+    types = get_types(MINIMAL).altair
+    return types.SyncCommitteeMessage(
+        slot=slot,
+        beacon_block_root=chain.head_root,
+        validator_index=validator_index,
+        signature=sk.sign(root).to_bytes(),
+    )
+
+
+def test_message_accept_then_duplicate_ignore(chain_setup):
+    config, types, chain = chain_setup
+    msg = _make_message(config, chain, subnet=0, position=0)
+    res = validate_gossip_sync_committee(chain, types, msg, 0)
+    assert res.action == GossipAction.ACCEPT, res.reason
+    assert res.attesting_index == 0  # position in the subcommittee
+    # identical second delivery: IGNORE (seen cache)
+    res2 = validate_gossip_sync_committee(chain, types, msg, 0)
+    assert res2.action == GossipAction.IGNORE
+
+
+def test_message_bad_signature_rejected(chain_setup):
+    config, types, chain = chain_setup
+    msg = _make_message(config, chain, subnet=0, position=1, flip_sig=True)
+    res = validate_gossip_sync_committee(chain, types, msg, 0)
+    assert res.action == GossipAction.REJECT
+    assert "signature" in res.reason
+
+
+def test_message_wrong_subcommittee_rejected(chain_setup):
+    config, types, chain = chain_setup
+    members0 = _sync_subcommittee_members(chain, 0)
+    # find a subnet whose membership differs for this validator
+    target = None
+    for subnet in range(1, 4):
+        if members0[2] not in _sync_subcommittee_members(chain, subnet):
+            target = subnet
+            break
+    if target is None:
+        pytest.skip("validator sits in every subcommittee in this tiny state")
+    msg = _make_message(config, chain, subnet=0, position=2)
+    res = validate_gossip_sync_committee(chain, types, msg, target)
+    assert res.action == GossipAction.REJECT
+    assert "subcommittee" in res.reason
+
+
+def test_message_out_of_range_subnet_and_wrong_slot(chain_setup):
+    config, types, chain = chain_setup
+    msg = _make_message(config, chain, subnet=0, position=3)
+    assert (
+        validate_gossip_sync_committee(chain, types, msg, 7).action
+        == GossipAction.REJECT
+    )
+    stale = _make_message(config, chain, subnet=0, position=3, slot=0)
+    assert (
+        validate_gossip_sync_committee(chain, types, stale, 0).action
+        == GossipAction.IGNORE
+    )
+
+
+def _make_contribution(
+    config, chain, subnet=0, agg_position=0, n_participants=3, slot=1,
+    flip_aggregate=False, flip_envelope=False,
+):
+    types = get_types(MINIMAL).altair
+    members = _sync_subcommittee_members(chain, subnet)
+    aggregator_index = members[agg_position]
+
+    # participants sign the head root
+    domain = config.get_domain(DOMAIN_SYNC_COMMITTEE, slot, slot // SPE)
+    root = compute_signing_root(chain.head_root, domain)
+    bits = [False] * SUBNET_SIZE
+    sigs = []
+    for pos in range(n_participants):
+        bits[pos] = True
+        sigs.append(_sk(members[pos] + (99 if flip_aggregate else 0)).sign(root))
+    aggregate = (
+        bls.aggregate_signatures(sigs).to_bytes() if sigs else b"\xc0" + b"\x00" * 95
+    )
+    contribution = types.SyncCommitteeContribution(
+        slot=slot,
+        beacon_block_root=chain.head_root,
+        subcommittee_index=subnet,
+        aggregation_bits=bits,
+        signature=aggregate,
+    )
+
+    sel_domain = config.get_domain(
+        DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, slot, slot // SPE
+    )
+    sel_data = types.SyncAggregatorSelectionData(slot=slot, subcommittee_index=subnet)
+    proof = _sk(aggregator_index).sign(
+        compute_signing_root(sel_data.hash_tree_root(), sel_domain)
+    ).to_bytes()
+
+    cap = types.ContributionAndProof(
+        aggregator_index=aggregator_index,
+        contribution=contribution,
+        selection_proof=proof,
+    )
+    env_domain = config.get_domain(DOMAIN_CONTRIBUTION_AND_PROOF, slot, slot // SPE)
+    env_signer = aggregator_index + (99 if flip_envelope else 0)
+    env_sig = _sk(env_signer).sign(
+        compute_signing_root(cap.hash_tree_root(), env_domain)
+    ).to_bytes()
+    return types.SignedContributionAndProof(message=cap, signature=env_sig)
+
+
+def test_contribution_accept_then_dedup(chain_setup):
+    config, types, chain = chain_setup
+    # minimal preset: subcommittee size 8 // TARGET 16 → modulo 1, every
+    # selection proof selects (keeps the aggregator gate testable)
+    signed = _make_contribution(config, chain, subnet=1, n_participants=3)
+    assert is_sync_committee_aggregator(
+        signed.message.selection_proof, chain.preset
+    )
+    res = validate_gossip_sync_contribution_and_proof(chain, types, signed)
+    assert res.action == GossipAction.ACCEPT, res.reason
+    # same aggregator again (fewer participants → not a superset IGNORE,
+    # but the aggregator-known IGNORE)
+    fewer = _make_contribution(config, chain, subnet=1, n_participants=2)
+    res2 = validate_gossip_sync_contribution_and_proof(chain, types, fewer)
+    assert res2.action == GossipAction.IGNORE
+    # different aggregator, subset participants → superset IGNORE
+    subset = _make_contribution(
+        config, chain, subnet=1, agg_position=4, n_participants=2
+    )
+    res3 = validate_gossip_sync_contribution_and_proof(chain, types, subset)
+    assert res3.action == GossipAction.IGNORE
+    assert "participants" in res3.reason
+
+
+def test_contribution_bad_signatures_rejected(chain_setup):
+    config, types, chain = chain_setup
+    bad_agg = _make_contribution(
+        config, chain, subnet=2, n_participants=2, flip_aggregate=True
+    )
+    res = validate_gossip_sync_contribution_and_proof(chain, types, bad_agg)
+    assert res.action == GossipAction.REJECT
+    assert "signature" in res.reason
+
+    bad_env = _make_contribution(
+        config, chain, subnet=2, agg_position=5, n_participants=2,
+        flip_envelope=True,
+    )
+    res2 = validate_gossip_sync_contribution_and_proof(chain, types, bad_env)
+    assert res2.action == GossipAction.REJECT
+
+
+def test_contribution_no_participants_rejected(chain_setup):
+    config, types, chain = chain_setup
+    signed = _make_contribution(config, chain, subnet=3, n_participants=0)
+    res = validate_gossip_sync_contribution_and_proof(chain, types, signed)
+    assert res.action == GossipAction.REJECT
+    assert "participants" in res.reason
+
+
+def test_contribution_out_of_range_subcommittee(chain_setup):
+    config, types, chain = chain_setup
+    signed = _make_contribution(config, chain, subnet=0, agg_position=6)
+    signed.message.contribution.subcommittee_index = 9
+    res = validate_gossip_sync_contribution_and_proof(chain, types, signed)
+    assert res.action == GossipAction.REJECT
+
+
+def test_duplicate_positions_all_reported(chain_setup):
+    """Sync committees sample with replacement: one validator can hold
+    several positions of a subcommittee, and its single (deduped) message
+    must carry every position so the pool sets all its bits."""
+    config, types, chain = chain_setup
+    from lodestar_tpu.chain.validation import _sync_subcommittee_members
+
+    found = None
+    for subnet in range(4):
+        members = _sync_subcommittee_members(chain, subnet)
+        for v in members:
+            if members.count(v) > 1:
+                found = (subnet, v, [i for i, x in enumerate(members) if x == v])
+                break
+        if found:
+            break
+    if not found:
+        pytest.skip("no duplicated member in this committee sample")
+    subnet, validator, positions = found
+    pos0 = positions[0]
+    msg = _make_message(config, chain, subnet=subnet, position=pos0)
+    chain.seen_sync_committee._seen.discard((1, subnet, validator))
+    res = validate_gossip_sync_committee(chain, types, msg, subnet)
+    assert res.action == GossipAction.ACCEPT, res.reason
+    assert res.positions == positions
