@@ -73,6 +73,13 @@ class BeaconChain:
         # serializes chain mutation between the event loop (gossip) and
         # worker threads (range sync, REST) — see process_block
         self.import_lock = threading.RLock()
+        # two helpers for the 3-way parallel block verification
+        # (signatures ∥ payload, overlapping the host state transition)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._verify_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="blockverify"
+        )
 
         cached = CachedBeaconState(config, anchor_state, self.preset)
         self.head_state = cached
@@ -189,24 +196,44 @@ class BeaconChain:
         if block.slot <= finalized_slot:
             raise BlockImportError("block slot not after finalized")
 
-        # pre-state
+        # pre-state (advanced to the block's slot: its epoch context covers
+        # the block's committees/proposer, so signature sets can be built
+        # BEFORE the state transition — the key to the 3-way overlap)
         pre = self._get_pre_state(signed_block)
-        # state transition without inline signature verification
-        post = pre.copy()
-        state_transition(
-            post, self.types, signed_block,
-            verify_state_root=True, verify_signatures=False,
-        )
-        # batched signature verification via the pluggable verifier (the
-        # post state's epoch context covers the block's committees/proposer)
+
+        # 3-way parallel verification (reference verifyBlock.ts:69-80:
+        # state transition ∥ BLS signatures ∥ execution payload). The
+        # signature batch releases the GIL in the native marshal + device
+        # dispatch, and the payload check blocks on the EL's HTTP reply,
+        # so both genuinely overlap the pure-Python state transition.
+        fut_sig = fut_payload = None
         if verify_signatures:
-            sets = get_block_signature_sets(post, self.types, signed_block)
-            if not self.bls.verify_signature_sets(sets):
+            sets = get_block_signature_sets(pre, self.types, signed_block)
+            fut_sig = self._verify_pool.submit(self.bls.verify_signature_sets, sets)
+        fut_payload = self._verify_pool.submit(
+            self._verify_execution_payload, pre, signed_block
+        )
+
+        try:
+            post = pre.copy()
+            state_transition(
+                post, self.types, signed_block,
+                verify_state_root=True, verify_signatures=False,
+            )
+            if fut_sig is not None and not fut_sig.result():
                 raise BlockImportError("block signature set verification failed")
-        # execution payload verification (reference runs this in parallel
-        # with the two above — verifyBlocksExecutionPayloads.ts); SYNCING/
-        # ACCEPTED imports optimistically, INVALID rejects
-        self._verify_execution_payload(post, signed_block)
+            fut_payload.result()  # raises BlockImportError on INVALID
+        except BaseException:
+            # never abandon in-flight work: an orphaned payload check
+            # would pin a pool worker on the EL's HTTP timeout and
+            # serialize the NEXT import behind it (round-2 review)
+            for fut in (fut_sig, fut_payload):
+                if fut is not None:
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass
+            raise
 
         self._import_block(signed_block, block_root, post)
         return block_root
@@ -461,13 +488,38 @@ class BeaconChain:
         prop_slash, att_slash, exits = self.op_pool.get_slashings_and_exits(
             pre, self.preset
         )
+        # eth1 vote + pending deposits via the tracker when one is wired
+        # (node opts.eth1_provider; reference produceBlockBody eth1 data
+        # vote + deposits from the eth1 cache)
+        tracker = getattr(self, "eth1_tracker", None)
+        eth1_data = pre.state.eth1_data.copy()
+        deposits = []
+        if tracker is not None:
+            # READ-only here: following (log catch-up over JSON-RPC) runs
+            # on the node's slot cadence in the background — a historical
+            # sync inline would blow the proposal deadline (round-2
+            # review finding)
+            try:
+                eth1_data = tracker.get_eth1_vote(
+                    pre.state, int(self.clock.time_fn())
+                )
+                deposits = tracker.get_deposits_for_block(pre.state)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "eth1 tracker failed; producing without deposits"
+                )
+                eth1_data = pre.state.eth1_data.copy()
+                deposits = []
         body = types.BeaconBlockBody(
             randao_reveal=randao_reveal,
-            eth1_data=pre.state.eth1_data.copy(),
+            eth1_data=eth1_data,
             graffiti=graffiti.ljust(32, b"\x00")[:32],
             proposer_slashings=[s.copy() for s in prop_slash],
             attester_slashings=[s.copy() for s in att_slash],
             attestations=attestations,
+            deposits=deposits,
             voluntary_exits=[e.copy() for e in exits],
         )
         if hasattr(body, "sync_aggregate"):

@@ -19,10 +19,34 @@ class RegenError(ValueError):
 
 
 class StateRegenerator:
+    # reference QueuedStateRegenerator: JobItemQueue maxLength 256 — a
+    # deep-replay storm must reject, not pile up unboundedly
+    MAX_PENDING = 256
+
     def __init__(self, chain):
         self.chain = chain
         # (parent_root, slot) → advanced pre-state; see get_pre_state
         self._block_slot_cache: dict[tuple[bytes, int], object] = {}
+        import threading
+
+        # serialize expensive replays (the reference queues them for the
+        # same reason: concurrent deep replays multiply the work) and
+        # bound how many callers may wait
+        self._replay_lock = threading.Lock()
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+
+    def _admit(self):
+        with self._pending_lock:
+            if self._pending >= self.MAX_PENDING:
+                raise RegenError(
+                    f"regen queue full ({self.MAX_PENDING} pending replays)"
+                )
+            self._pending += 1
+
+    def _done(self):
+        with self._pending_lock:
+            self._pending -= 1
 
     def get_state_by_root(self, state_root: bytes):
         cached = self.chain.state_cache.get(state_root)
@@ -32,7 +56,21 @@ class StateRegenerator:
 
     def get_state_for_block(self, block_root: bytes):
         """State after applying the block with `block_root` (replaying
-        ancestors from the nearest cached state if needed)."""
+        ancestors from the nearest cached state if needed). Replays are
+        serialized and bounded (MAX_PENDING) like the reference's queued
+        regenerator."""
+        cached = self.chain.state_cache.get_by_block_root(block_root)
+        if cached is not None:
+            return cached
+        self._admit()
+        try:
+            with self._replay_lock:
+                return self._replay_for_block(block_root)
+        finally:
+            self._done()
+
+    def _replay_for_block(self, block_root: bytes):
+        # re-check under the lock: a concurrent replay may have cached it
         cached = self.chain.state_cache.get_by_block_root(block_root)
         if cached is not None:
             return cached

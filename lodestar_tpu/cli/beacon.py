@@ -99,6 +99,20 @@ def run_beacon(args) -> int:
         secret = bytes.fromhex(args.jwt_secret) if args.jwt_secret else b"\x00" * 32
         engine = ExecutionEngineHttp(host or "127.0.0.1", int(port), secret)
 
+    eth1_provider = None
+    if args.eth1_endpoint:
+        from ..eth1.provider import Eth1ProviderHttp
+
+        e1_host, _, e1_port = args.eth1_endpoint.rpartition(":")
+        eth1_provider = Eth1ProviderHttp(
+            config,
+            types,
+            e1_host or "127.0.0.1",
+            int(e1_port),
+            deploy_block=args.eth1_deploy_block,
+        )
+        log.info("eth1 deposit follower: %s", args.eth1_endpoint)
+
     node = BeaconNode.init(
         config,
         types,
@@ -111,6 +125,7 @@ def run_beacon(args) -> int:
             metrics_port=args.metrics_port,
             tpu_verifier=args.tpu_verifier,
             execution_engine=engine,
+            eth1_provider=eth1_provider,
         ),
     )
 
@@ -346,6 +361,8 @@ def add_beacon_parser(sub) -> None:
     p.add_argument("--metrics", action="store_true")
     p.add_argument("--metrics-port", type=int, default=8008)
     p.add_argument("--execution", default=None, help='"mock" or host:port of an EL engine API')
+    p.add_argument("--eth1-endpoint", default=None, help="host:port of an eth1 JSON-RPC node (deposit follower)")
+    p.add_argument("--eth1-deploy-block", type=int, default=0, help="deposit contract deployment block")
     p.add_argument("--jwt-secret", default=None, help="hex engine-API JWT secret")
     p.add_argument("--tpu-verifier", action="store_true")
     p.add_argument("--run-seconds", type=float, default=0, help="exit after N seconds (0 = forever)")

@@ -224,7 +224,8 @@ def bls_g1_aggregate(pks: bytes, check_each: bool = True):
 
 def bls_marshal_sets(pks: bytes, msgs: bytes, sigs: bytes, dst: bytes,
                      check_pk_subgroup: bool = False,
-                     check_sig_subgroup: bool = True):
+                     check_sig_subgroup: bool = True,
+                     do_hash: bool = True):
     """Batch-marshal n signature sets straight into device arrays.
 
     pks n×48B, msgs n×32B signing roots, sigs n×96B →
@@ -235,11 +236,15 @@ def bls_marshal_sets(pks: bytes, msgs: bytes, sigs: bytes, dst: bytes,
     batch is the hot-path waste the reference also avoids by trusting its
     pubkey cache (worker.ts deserializes affine without re-checking).
     Signature subgroup checks default ON (sigFromBytes validates).
+    do_hash=False skips the per-set hash-to-curve (msg arrays stay zero)
+    so callers can fill them from a cache — committee gossip shares
+    signing roots, making per-set hashing mostly redundant.
     """
     import numpy as np
 
     buf, ok = _mod.bls_marshal_sets(
-        pks, msgs, sigs, dst, int(check_pk_subgroup), int(check_sig_subgroup)
+        pks, msgs, sigs, dst, int(check_pk_subgroup), int(check_sig_subgroup),
+        int(do_hash),
     )
     n = len(ok)
     a = np.frombuffer(buf, np.int32)

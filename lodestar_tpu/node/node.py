@@ -34,6 +34,7 @@ class NodeOptions:
     metrics_port: int = 0
     tpu_verifier: bool = False
     execution_engine: object | None = None
+    eth1_provider: object | None = None  # IEth1Provider (mock or HTTP)
     notifier_interval_slots: int = 1
 
 
@@ -79,6 +80,16 @@ class BeaconNode:
             execution_engine=opts.execution_engine,
         )
 
+        # 3b. eth1 deposit follower (live JSON-RPC or mock; None = none)
+        self.eth1_tracker = None
+        if opts.eth1_provider is not None:
+            from ..eth1 import Eth1DepositTracker
+
+            self.eth1_tracker = Eth1DepositTracker(
+                config, types, opts.eth1_provider
+            )
+            self.chain.eth1_tracker = self.eth1_tracker
+
         # 4. network + sync are attached by the caller once a transport
         # exists (dev mode runs networkless, like reference dev w/o peers)
         self.peers = []
@@ -118,11 +129,34 @@ class BeaconNode:
         self.chain.clock.set_slot(slot)
         self.chain.fork_choice.update_time(slot)
         self.chain.prepare_next_slot.on_slot(slot)
+        self._follow_eth1_async()
         m = self.metrics
         m.head_slot.set(self.chain.head_state.state.slot)
         m.current_justified_epoch.set(self.chain.justified_checkpoint[0])
         m.finalized_epoch.set(self.chain.finalized_checkpoint[0])
         self.notifier.on_slot(slot)
+
+    def _follow_eth1_async(self) -> None:
+        """Kick the deposit-log follower on a background thread, at most
+        one catch-up in flight (reference: periodic eth1 update loop —
+        the initial historical sync can take minutes and must never block
+        the slot path or a proposal)."""
+        tracker = self.eth1_tracker
+        if tracker is None or getattr(self, "_eth1_following", False):
+            return
+        self._eth1_following = True
+
+        def _run():
+            try:
+                tracker.follow()
+            except Exception as e:
+                self.log.warning("eth1 follow failed: %s", e)
+            finally:
+                self._eth1_following = False
+
+        import threading
+
+        threading.Thread(target=_run, name="eth1follow", daemon=True).start()
 
     def run(self, slots: int, slot_time: float = 0.0, on_slot=None) -> None:
         """Drive `slots` wall-clock slots (dev/test; production would follow
@@ -150,4 +184,5 @@ class BeaconNode:
             self.api_server.close()
         if self.metrics_server:
             self.metrics_server.close()
+        self.chain._verify_pool.shutdown(wait=False)
         self.db.close()

@@ -73,6 +73,57 @@ def conj(a):
     return _join(a0, fp6.neg(a1))
 
 
+def cyclotomic_square(g):
+    """Granger–Scott squaring — valid ONLY for elements of the cyclotomic
+    subgroup G_{Φ6}(Fp2) (anything after the final exponentiation's easy
+    part). 9 Fp2 squarings in one stacked call vs the generic square's 12
+    Fp2 products, and a flatter add tree.
+
+    With c0 = (a, b, c), c1 = (d, e, f) over Fp2 (the three Fp4
+    subalgebras (a,e), (c,d), (b,f) with y² = ξ):
+        t0 = a² + ξe²   t6 = 2ae
+        t2 = d² + ξc²   t7 = 2cd
+        t4 = b² + ξf²   t8 = 2bf·ξ
+        c0' = (3t0−2a, 3t2−2b, 3t4−2c)
+        c1' = (3t8+2d, 3t6+2e, 3t7+2f)
+    Differentially pinned against the oracle's generic square on
+    cyclotomic inputs (tests/test_ops_pairing.py)."""
+    g0, g1 = _split(g)
+    a, b, c = fp6._split(g0)
+    d, e, f = fp6._split(g1)
+    lhs = jnp.stack(
+        [a, e, fp2.add(a, e), c, d, fp2.add(c, d), f, b, fp2.add(b, f)], axis=0
+    )
+    s = fp2.mul(lhs, lhs)
+    a2, e2, ae2, c2, d2, cd2, f2, b2, bf2 = (s[i] for i in range(9))
+    t6 = fp2.sub(fp2.sub(ae2, a2), e2)  # 2ae
+    t7 = fp2.sub(fp2.sub(cd2, c2), d2)  # 2cd
+    t8 = fp2.mul_by_xi(fp2.sub(fp2.sub(bf2, b2), f2))  # 2bf·ξ
+    t0 = fp2.add(fp2.mul_by_xi(e2), a2)
+    t2 = fp2.add(fp2.mul_by_xi(c2), d2)
+    t4 = fp2.add(fp2.mul_by_xi(f2), b2)
+
+    def three_t_minus_2x(t, x):
+        y = fp2.sub(t, x)
+        return fp2.add(fp2.add(y, y), t)
+
+    def three_t_plus_2x(t, x):
+        y = fp2.add(t, x)
+        return fp2.add(fp2.add(y, y), t)
+
+    c0 = fp6._join(
+        three_t_minus_2x(t0, a),
+        three_t_minus_2x(t2, b),
+        three_t_minus_2x(t4, c),
+    )
+    c1 = fp6._join(
+        three_t_plus_2x(t8, d),
+        three_t_plus_2x(t6, e),
+        three_t_plus_2x(t7, f),
+    )
+    return _join(c0, c1)
+
+
 def inv(a):
     """(c0 + c1w)⁻¹ = (c0 − c1w)/(c0² − v·c1²)."""
     a0, a1 = _split(a)

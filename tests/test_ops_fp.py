@@ -164,3 +164,27 @@ def test_ks_carry_matches_carry_scan():
     got_ks = np.asarray(jax.jit(fp.ks_carry)(jnp.asarray(cols)))
     got_scan = np.asarray(jax.jit(fp.carry_scan)(jnp.asarray(cols)))
     assert np.array_equal(got_ks, got_scan)
+
+
+def test_cyclotomic_square_matches_oracle():
+    """Granger–Scott squaring == generic square on cyclotomic elements
+    (the final exponentiation hard part runs entirely on these)."""
+    from lodestar_tpu.bls import fields as f
+    from lodestar_tpu.ops import fp12
+    from lodestar_tpu.ops.io_host import fq12_to_limbs, limbs_to_fq12
+
+    rng2 = random.Random(4)
+
+    def rand_fq2():
+        return f.Fq2(f.Fq(rng2.randrange(f.P)), f.Fq(rng2.randrange(f.P)))
+
+    for _ in range(3):
+        x = f.Fq12(
+            f.Fq6(rand_fq2(), rand_fq2(), rand_fq2()),
+            f.Fq6(rand_fq2(), rand_fq2(), rand_fq2()),
+        )
+        g = x.conjugate() * x.inverse()  # easy part: into the subgroup
+        g = g.frobenius(2) * g
+        limbs = fq12_to_limbs(g)
+        got = limbs_to_fq12(np.asarray(jax.jit(fp12.cyclotomic_square)(limbs)))
+        assert got == g * g

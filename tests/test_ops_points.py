@@ -139,3 +139,37 @@ def test_generator_constants_roundtrip():
     gen2 = oc.PointG2.generator()
     got2 = _g2_back(points.g2.from_affine(points.G2_GEN_X, points.G2_GEN_Y))
     assert got2 == gen2
+
+
+@pytest.mark.slow
+def test_scalar_mul_windowed_matches_bit_ladder():
+    """The windowed ladder (verifier default) must agree with the bit
+    ladder and the oracle for random 64-bit scalars, on both curves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lodestar_tpu.bls import curve as oc
+    from lodestar_tpu.ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
+    from lodestar_tpu.ops.points import g1, g2
+
+    rng = np.random.default_rng(5)
+    scalars = [int(x) for x in rng.integers(1, 1 << 64, 3, dtype=np.uint64)]
+    bits = np.zeros((3, 64), np.int32)
+    for i, k in enumerate(scalars):
+        for j in range(64):
+            bits[i, j] = (k >> (63 - j)) & 1
+
+    for curve, gen, to_limbs in (
+        (g1, oc.PointG1.generator(), g1_affine_to_limbs),
+        (g2, oc.PointG2.generator(), g2_affine_to_limbs),
+    ):
+        gx, gy, _ = to_limbs(gen)
+        p_bits = jax.jit(curve.scalar_mul_bits)(jnp.asarray(bits), (gx, gy))
+        p_win = jax.jit(curve.scalar_mul_windowed)(jnp.asarray(bits), (gx, gy))
+        for i, k in enumerate(scalars):
+            wx, wy, _ = to_limbs(gen * k)
+            got = curve.to_affine(tuple(c[i] for c in p_win))
+            assert np.array_equal(np.asarray(got[0]), np.asarray(wx)), k
+            assert np.array_equal(np.asarray(got[1]), np.asarray(wy)), k
+            gb = curve.to_affine(tuple(c[i] for c in p_bits))
+            assert np.array_equal(np.asarray(got[0]), np.asarray(gb[0]))

@@ -15,6 +15,11 @@ import pytest
 
 from lodestar_tpu.utils.benchmark import BenchRunner
 
+# deep-kernel compiles / subprocess e2e: excluded from the default fast
+# suite (VERDICT round-1 weakness #4); run with `pytest -m slow` or -m ""
+pytestmark = pytest.mark.slow
+
+
 PERF = os.environ.get("LODESTAR_TPU_PERF") == "1"
 HISTORY = os.path.join(os.path.dirname(__file__), "..", ".bench_history.json")
 
