@@ -88,11 +88,15 @@ class BufferedVerifier:
     semantics, worker.ts:55-95 — realized as a second batched dispatch,
     not N round-trips)."""
 
-    def __init__(self, verifier: IBlsVerifier):
+    def __init__(self, verifier: IBlsVerifier, prom=None):
         self.verifier = verifier
-        self._buffer: list[tuple[list[bls.SignatureSet], asyncio.Future]] = []
+        self._buffer: list[tuple[list[bls.SignatureSet], asyncio.Future, float]] = []
         self._flush_task: asyncio.Task | None = None
         self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
+        # optional prometheus family bundle (create_beacon_metrics result):
+        # feeds the bls-verifier dashboard rows (queue depth, buffer wait,
+        # sets/job, fallback rate — reference blsThreadPool.*)
+        self.prom = prom
 
     async def verify(self, sets: Sequence[bls.SignatureSet], batchable: bool = False) -> bool:
         sets = list(sets)
@@ -102,8 +106,10 @@ class BufferedVerifier:
             return self.verifier.verify_signature_sets(sets)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._buffer.append((sets, fut))
-        buffered = sum(len(s) for s, _ in self._buffer)
+        self._buffer.append((sets, fut, time.monotonic()))
+        buffered = sum(len(s) for s, _, _ in self._buffer)
+        if self.prom is not None:
+            self.prom.bls_buffer_depth.set(buffered)
         if buffered >= MAX_BUFFERED_SIGS:
             self._flush()
         elif self._flush_task is None:
@@ -121,22 +127,32 @@ class BufferedVerifier:
         buffer, self._buffer = self._buffer, []
         if not buffer:
             return
+        now = time.monotonic()
         merged: list[bls.SignatureSet] = []
-        for sets, _ in buffer:
+        for sets, _, enq in buffer:
             merged.extend(sets)
+            if self.prom is not None:
+                self.prom.bls_buffer_wait_seconds.observe(now - enq)
         self.metrics["batches"] += 1
         self.metrics["sigs_verified"] += len(merged)
+        if self.prom is not None:
+            self.prom.bls_buffer_depth.set(0)
+            self.prom.bls_job_sets.observe(len(merged))
+            self.prom.bls_batches_total.inc()
+            self.prom.bls_sets_total.inc(len(merged))
         ok = self.verifier.verify_signature_sets(merged)
         if ok:
-            for _, fut in buffer:
+            for _, fut, _ in buffer:
                 if not fut.done():
                     fut.set_result(True)
             return
         # batch failed: resolve per-request from one individual pass
         self.metrics["batch_fallbacks"] += 1
+        if self.prom is not None:
+            self.prom.bls_batch_fallbacks_total.inc()
         verdicts = self.verifier.verify_signature_sets_individual(merged)
         pos = 0
-        for sets, fut in buffer:
+        for sets, fut, _ in buffer:
             share = verdicts[pos : pos + len(sets)]
             pos += len(sets)
             if not fut.done():

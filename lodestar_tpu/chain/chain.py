@@ -206,7 +206,11 @@ class BeaconChain:
         # signature batch releases the GIL in the native marshal + device
         # dispatch, and the payload check blocks on the EL's HTTP reply,
         # so both genuinely overlap the pure-Python state transition.
+        import time as _time
+
+        m = getattr(self, "metrics", None)
         fut_sig = fut_payload = None
+        t_start = _time.monotonic()
         if verify_signatures:
             sets = get_block_signature_sets(pre, self.types, signed_block)
             fut_sig = self._verify_pool.submit(self.bls.verify_signature_sets, sets)
@@ -220,9 +224,22 @@ class BeaconChain:
                 post, self.types, signed_block,
                 verify_state_root=True, verify_signatures=False,
             )
+            t_stf = _time.monotonic()
+            if m is not None:
+                m.block_stf_seconds.observe(t_stf - t_start)
             if fut_sig is not None and not fut_sig.result():
+                if m is not None:
+                    m.block_import_errors_total.inc(reason="signature")
                 raise BlockImportError("block signature set verification failed")
+            t_sig = _time.monotonic()
+            if m is not None and fut_sig is not None:
+                # wait beyond the STF, i.e. the non-overlapped signature tail
+                m.block_sig_seconds.observe(t_sig - t_stf)
             fut_payload.result()  # raises BlockImportError on INVALID
+            if m is not None:
+                m.block_payload_seconds.observe(_time.monotonic() - t_sig)
+                m.block_import_seconds.observe(_time.monotonic() - t_start)
+                m.processed_blocks_total.inc()
         except BaseException:
             # never abandon in-flight work: an orphaned payload check
             # would pin a pool worker on the EL's HTTP timeout and

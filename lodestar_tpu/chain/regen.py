@@ -37,16 +37,24 @@ class StateRegenerator:
         self._pending_lock = threading.Lock()
 
     def _admit(self):
+        m = getattr(self.chain, "metrics", None)
         with self._pending_lock:
             if self._pending >= self.MAX_PENDING:
+                if m is not None:
+                    m.regen_rejections_total.inc()
                 raise RegenError(
                     f"regen queue full ({self.MAX_PENDING} pending replays)"
                 )
             self._pending += 1
+            if m is not None:
+                m.regen_queue_pending.set(self._pending)
 
     def _done(self):
+        m = getattr(self.chain, "metrics", None)
         with self._pending_lock:
             self._pending -= 1
+            if m is not None:
+                m.regen_queue_pending.set(self._pending)
 
     def get_state_by_root(self, state_root: bytes):
         cached = self.chain.state_cache.get(state_root)
@@ -74,6 +82,9 @@ class StateRegenerator:
         cached = self.chain.state_cache.get_by_block_root(block_root)
         if cached is not None:
             return cached
+        m = getattr(self.chain, "metrics", None)
+        if m is not None:
+            m.regen_replays_total.inc()
         # walk back through fork choice ancestry to a cached state
         chain_path = []
         root = block_root

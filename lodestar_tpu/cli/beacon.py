@@ -60,9 +60,20 @@ def run_beacon(args) -> int:
             # legacy layout: --datadir pointed straight at the db log file
             log.info("using legacy single-file datadir layout")
             db_controller = FileDb(args.datadir)
+        elif os.path.isfile(os.path.join(args.datadir, "chain.db")):
+            # round-1 datadir (python log format): keep reading it
+            log.info("using round-1 FileDb datadir layout")
+            db_controller = FileDb(os.path.join(args.datadir, "chain.db"))
         else:
             os.makedirs(args.datadir, exist_ok=True)
-            db_controller = FileDb(os.path.join(args.datadir, "chain.db"))
+            try:
+                from ..db.controller import NativeKvDb
+
+                db_controller = NativeKvDb(os.path.join(args.datadir, "kv"))
+                log.info("native KV engine at %s/kv", args.datadir)
+            except (RuntimeError, OSError) as e:
+                log.warning("native KV unavailable (%s); FileDb fallback", e)
+                db_controller = FileDb(os.path.join(args.datadir, "chain.db"))
     else:
         db_controller = MemoryDb()
     probe_db = BeaconDb(types_all.phase0, db_controller)

@@ -138,3 +138,88 @@ class FileDb(MemoryDb):
 
     def close(self) -> None:
         self._fh.close()
+
+
+class NativeKvDb:
+    """IDatabaseController over the native C storage engine
+    (`native/src/kvstore.c` — the leveldown/LevelDB-class tier,
+    SURVEY.md §2.3). Values live on disk; only the key index is in
+    memory, so datadirs can exceed process memory. Crash-tolerant
+    (CRC-framed records, torn tails dropped on replay), batched writes
+    fsync once, dead space reclaimed by compaction.
+
+    Thread-safe: one lock serializes writers (the engine itself is
+    single-writer by design).
+    """
+
+    def __init__(self, path: str):
+        import threading
+
+        from .. import native
+
+        if not native.HAVE_NATIVE or not hasattr(native._mod, "kv_open"):
+            raise RuntimeError(
+                "native KV engine unavailable (no C toolchain?) — "
+                "use FileDb for pure-Python persistence"
+            )
+        self._mod = native._mod
+        self._h = self._mod.kv_open(path)
+        self._lock = threading.Lock()
+        self.path = path
+
+    # NOTE: the C engine mutates its index with the GIL released, so
+    # READERS take the same lock as writers (round-2 review: a reader
+    # racing kv_grow/compact would use-after-free).
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._mod.kv_get(self._h, key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._mod.kv_put(self._h, key, value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._mod.kv_delete(self._h, key)
+
+    def batch_put(self, items) -> None:
+        with self._lock:
+            self._mod.kv_batch_put(self._h, [(bytes(k), bytes(v)) for k, v in items])
+            self._mod.kv_compact(self._h)  # no-op below the dead-ratio gate
+
+    def keys_stream(self, gte: bytes, lt: bytes):
+        with self._lock:
+            keys = self._mod.kv_keys_range(self._h, gte, lt)
+        yield from keys
+
+    def values_stream(self, gte: bytes, lt: bytes):
+        for _, v in self.entries_stream(gte, lt):
+            yield v
+
+    def entries_stream(self, gte: bytes, lt: bytes):
+        with self._lock:
+            keys = self._mod.kv_keys_range(self._h, gte, lt)
+        for k in keys:
+            with self._lock:
+                v = self._mod.kv_get(self._h, k)
+            if v is not None:
+                yield k, v
+
+    def stats(self) -> dict:
+        with self._lock:
+            count, live, dead, seg = self._mod.kv_stats(self._h)
+        return {
+            "entries": count,
+            "live_bytes": live,
+            "dead_bytes": dead,
+            "active_segment": seg,
+        }
+
+    def compact(self) -> None:
+        with self._lock:
+            self._mod.kv_compact(self._h, 1)
+
+    def close(self) -> None:
+        with self._lock:
+            self._h = None  # capsule destructor closes + fsyncs

@@ -61,7 +61,8 @@ def _build() -> bool:
     import sysconfig
 
     src = [os.path.join(_HERE, "src", f) for f in (
-        "module.c", "sha256.c", "xxhash64.c", "snappy_codec.c", "bls12.c"
+        "module.c", "sha256.c", "xxhash64.c", "snappy_codec.c", "bls12.c",
+        "kvstore.c"
     )]
     ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = os.path.join(_HERE, "_lodestar_native" + ext_suffix)

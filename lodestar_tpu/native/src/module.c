@@ -275,6 +275,255 @@ done:
   return Py_BuildValue("(NN)", out, ok);
 }
 
+
+/* ---- persistent KV engine (kvstore.c) ---- */
+
+typedef struct kv_store kv_store;
+kv_store *lodestar_kv_open(const char *dir);
+int lodestar_kv_put(kv_store *s, const uint8_t *key, size_t klen,
+                    const uint8_t *val, size_t vlen, int sync);
+int lodestar_kv_delete(kv_store *s, const uint8_t *key, size_t klen, int sync);
+int lodestar_kv_sync(kv_store *s);
+int64_t lodestar_kv_get(kv_store *s, const uint8_t *key, size_t klen,
+                        uint8_t *out, size_t out_cap);
+typedef struct { const uint8_t *key; uint16_t len; } kv_keyref;
+kv_keyref *lodestar_kv_range(kv_store *s, const uint8_t *gte, size_t gl,
+                             const uint8_t *lt, size_t ll, uint64_t *n_out);
+void lodestar_kv_stats(kv_store *s, uint64_t out[4]);
+int lodestar_kv_compact(kv_store *s);
+int lodestar_kv_should_compact(kv_store *s);
+void lodestar_kv_close(kv_store *s);
+
+static void kv_capsule_destruct(PyObject *cap) {
+  kv_store *s = PyCapsule_GetPointer(cap, "lodestar.kv");
+  if (s) lodestar_kv_close(s);
+}
+
+static kv_store *kv_from_capsule(PyObject *cap) {
+  if (!PyCapsule_IsValid(cap, "lodestar.kv")) {
+    PyErr_SetString(PyExc_ValueError, "invalid or closed KV handle");
+    return NULL;
+  }
+  return (kv_store *)PyCapsule_GetPointer(cap, "lodestar.kv");
+}
+
+static PyObject *py_kv_open(PyObject *self, PyObject *args) {
+  const char *dir;
+  if (!PyArg_ParseTuple(args, "s", &dir)) return NULL;
+  kv_store *s;
+  Py_BEGIN_ALLOW_THREADS
+  s = lodestar_kv_open(dir);
+  Py_END_ALLOW_THREADS
+  if (!s) {
+    PyErr_Format(PyExc_OSError, "kv_open failed for %s", dir);
+    return NULL;
+  }
+  return PyCapsule_New(s, "lodestar.kv", kv_capsule_destruct);
+}
+
+static PyObject *py_kv_put(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  Py_buffer key, val;
+  int sync = 1;
+  if (!PyArg_ParseTuple(args, "Oy*y*|i", &cap, &key, &val, &sync)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) {
+    PyBuffer_Release(&key);
+    PyBuffer_Release(&val);
+    return NULL;
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_kv_put(s, (const uint8_t *)key.buf, (size_t)key.len,
+                       (const uint8_t *)val.buf, (size_t)val.len, sync);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&key);
+  PyBuffer_Release(&val);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_OSError, "kv_put failed");
+    return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *py_kv_batch_put(PyObject *self, PyObject *args) {
+  PyObject *cap, *items;
+  if (!PyArg_ParseTuple(args, "OO", &cap, &items)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) return NULL;
+  PyObject *seq = PySequence_Fast(items, "batch items must be a sequence");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+    Py_buffer key, val;
+    if (!PyArg_ParseTuple(pair, "y*y*", &key, &val)) {
+      Py_DECREF(seq);
+      return NULL;
+    }
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = lodestar_kv_put(s, (const uint8_t *)key.buf, (size_t)key.len,
+                         (const uint8_t *)val.buf, (size_t)val.len, 0);
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&key);
+    PyBuffer_Release(&val);
+    if (rc != 0) {
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_OSError, "kv_put failed in batch");
+      return NULL;
+    }
+  }
+  Py_DECREF(seq);
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_kv_sync(s);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    PyErr_SetString(PyExc_OSError, "kv_sync failed");
+    return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *py_kv_get(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  Py_buffer key;
+  if (!PyArg_ParseTuple(args, "Oy*", &cap, &key)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) {
+    PyBuffer_Release(&key);
+    return NULL;
+  }
+  uint8_t small[4096];
+  int64_t rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_kv_get(s, (const uint8_t *)key.buf, (size_t)key.len, small,
+                       sizeof(small));
+  Py_END_ALLOW_THREADS
+  if (rc == -1) {
+    PyBuffer_Release(&key);
+    Py_RETURN_NONE;
+  }
+  if (rc == -2) {
+    PyBuffer_Release(&key);
+    PyErr_SetString(PyExc_OSError, "kv_get IO error");
+    return NULL;
+  }
+  if ((size_t)rc <= sizeof(small)) {
+    PyBuffer_Release(&key);
+    return PyBytes_FromStringAndSize((const char *)small, (Py_ssize_t)rc);
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)rc);
+  if (!out) {
+    PyBuffer_Release(&key);
+    return NULL;
+  }
+  int64_t rc2;
+  Py_BEGIN_ALLOW_THREADS
+  rc2 = lodestar_kv_get(s, (const uint8_t *)key.buf, (size_t)key.len,
+                        (uint8_t *)PyBytes_AS_STRING(out), (size_t)rc);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&key);
+  if (rc2 != rc) {
+    Py_DECREF(out);
+    PyErr_SetString(PyExc_OSError, "kv_get IO error");
+    return NULL;
+  }
+  return out;
+}
+
+static PyObject *py_kv_delete(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  Py_buffer key;
+  int sync = 1;
+  if (!PyArg_ParseTuple(args, "Oy*|i", &cap, &key, &sync)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) {
+    PyBuffer_Release(&key);
+    return NULL;
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_kv_delete(s, (const uint8_t *)key.buf, (size_t)key.len, sync);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&key);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_OSError, "kv_delete failed");
+    return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *py_kv_keys_range(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  Py_buffer gte, lt;
+  if (!PyArg_ParseTuple(args, "Oy*y*", &cap, &gte, &lt)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) {
+    PyBuffer_Release(&gte);
+    PyBuffer_Release(&lt);
+    return NULL;
+  }
+  uint64_t n = 0;
+  kv_keyref *arr = lodestar_kv_range(s, (const uint8_t *)gte.buf,
+                                     (size_t)gte.len, (const uint8_t *)lt.buf,
+                                     (size_t)lt.len, &n);
+  PyBuffer_Release(&gte);
+  PyBuffer_Release(&lt);
+  if (!arr) {
+    PyErr_NoMemory();
+    return NULL;
+  }
+  PyObject *out = PyList_New((Py_ssize_t)n);
+  if (!out) {
+    free(arr);
+    return NULL;
+  }
+  for (uint64_t i = 0; i < n; i++) {
+    PyObject *k =
+        PyBytes_FromStringAndSize((const char *)arr[i].key, arr[i].len);
+    if (!k) {
+      free(arr);
+      Py_DECREF(out);
+      return NULL;
+    }
+    PyList_SET_ITEM(out, (Py_ssize_t)i, k);
+  }
+  free(arr);
+  return out;
+}
+
+static PyObject *py_kv_stats(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  if (!PyArg_ParseTuple(args, "O", &cap)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) return NULL;
+  uint64_t st[4];
+  lodestar_kv_stats(s, st);
+  return Py_BuildValue("(KKKK)", (unsigned long long)st[0],
+                       (unsigned long long)st[1], (unsigned long long)st[2],
+                       (unsigned long long)st[3]);
+}
+
+static PyObject *py_kv_compact(PyObject *self, PyObject *args) {
+  PyObject *cap;
+  int force = 0;
+  if (!PyArg_ParseTuple(args, "O|i", &cap, &force)) return NULL;
+  kv_store *s = kv_from_capsule(cap);
+  if (!s) return NULL;
+  if (!force && !lodestar_kv_should_compact(s)) Py_RETURN_FALSE;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_kv_compact(s);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    PyErr_SetString(PyExc_OSError, "kv_compact failed");
+    return NULL;
+  }
+  Py_RETURN_TRUE;
+}
+
 static PyMethodDef methods[] = {
     {"sha256", py_sha256, METH_VARARGS, "SHA-256 digest"},
     {"sha256_level", py_sha256_level, METH_VARARGS,
@@ -293,6 +542,18 @@ static PyMethodDef methods[] = {
      "N*48B pubkeys -> (rc, x||y device limbs of the sum)"},
     {"bls_marshal_sets", py_bls_marshal_sets, METH_VARARGS,
      "batch: pubkeys/messages/signatures -> (device limb buffer, ok flags)"},
+    {"kv_open", py_kv_open, METH_VARARGS, "open/replay a KV datadir -> handle"},
+    {"kv_put", py_kv_put, METH_VARARGS, "put(handle, key, value, sync=1)"},
+    {"kv_batch_put", py_kv_batch_put, METH_VARARGS,
+     "batch_put(handle, [(k, v), ...]) with one fsync"},
+    {"kv_get", py_kv_get, METH_VARARGS, "get(handle, key) -> bytes | None"},
+    {"kv_delete", py_kv_delete, METH_VARARGS, "delete(handle, key, sync=1)"},
+    {"kv_keys_range", py_kv_keys_range, METH_VARARGS,
+     "sorted keys in [gte, lt) (empty bound = open)"},
+    {"kv_stats", py_kv_stats, METH_VARARGS,
+     "(count, live_bytes, dead_bytes, active_segment)"},
+    {"kv_compact", py_kv_compact, METH_VARARGS,
+     "compact(handle, force=0) -> bool (ran)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {PyModuleDef_HEAD_INIT, "_lodestar_native",

@@ -79,6 +79,7 @@ class BeaconNode:
             db=self.db,
             execution_engine=opts.execution_engine,
         )
+        self.chain.metrics = self.metrics
 
         # 3b. eth1 deposit follower (live JSON-RPC or mock; None = none)
         self.eth1_tracker = None
@@ -132,8 +133,32 @@ class BeaconNode:
         self._follow_eth1_async()
         m = self.metrics
         m.head_slot.set(self.chain.head_state.state.slot)
+        m.clock_slot.set(slot)
         m.current_justified_epoch.set(self.chain.justified_checkpoint[0])
         m.finalized_epoch.set(self.chain.finalized_checkpoint[0])
+        m.state_cache_size.set(len(self.chain.state_cache._cache))
+        m.fork_choice_nodes.set(len(self.chain.fork_choice.proto.nodes))
+        m.fork_choice_votes.set(len(self.chain.fork_choice._vote_next))
+        m.proposer_boost_active.set(
+            1 if self.chain.fork_choice.proposer_boost_root else 0
+        )
+        pool = self.chain.attestation_pool
+        m.op_pool_size.set(
+            sum(len(v) for v in pool._by_slot.values())
+            if hasattr(pool, "_by_slot")
+            else 0,
+            kind="attestations",
+        )
+        m.op_pool_size.set(len(self.chain.op_pool.voluntary_exits), kind="exits")
+        m.op_pool_size.set(
+            len(self.chain.op_pool.attester_slashings), kind="attester_slashings"
+        )
+        stats = getattr(self.db.db, "stats", None)
+        if callable(stats):
+            st = stats()
+            m.db_entries.set(st["entries"])
+            m.db_live_bytes.set(st["live_bytes"])
+            m.db_dead_bytes.set(st["dead_bytes"])
         self.notifier.on_slot(slot)
 
     def _follow_eth1_async(self) -> None:

@@ -179,7 +179,15 @@ class CurveOps:
 
     def scalar_mul_windowed(self, bits, q_affine, window: int = 4):
         """[k]Q via fixed 2^w windows: same contract as `scalar_mul_bits`
-        but ~half the group additions for 64-bit scalars.
+        with ~half the group additions for 64-bit scalars.
+
+        MEASURED NEGATIVE RESULT on v5e (round 2, tools/win_check.py):
+        despite the op-count win, this runs SLOWER than the bit ladder
+        (G2 @512 lanes: 307 vs 262 ms) — the 2^w per-lane table selects
+        (16 vectorized where()s per window) outweigh the saved mixed
+        adds, and XLA compile time grows ~30x (the unrolled table build
+        + select trees). Kept as a pinned, differential-tested option;
+        the verifier kernels use `scalar_mul_bits`.
 
         Per window step: w doublings + ONE complete addition of the
         table entry T[digit] (T = [0·Q .. (2^w−1)·Q], 2^w−2 mixed adds

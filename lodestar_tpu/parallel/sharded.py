@@ -39,8 +39,8 @@ def _local_body(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     product, local partial G2 signature sum) — the two values that cross
     the ICI boundary."""
     n_loc = pk_x.shape[0]
-    rpk = g1.scalar_mul_windowed(r_bits, (pk_x, pk_y))
-    rsig = g2.scalar_mul_windowed(r_bits, (sig_x, sig_y))
+    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+    rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
     rsig = g2.select(valid, rsig, g2.infinity((n_loc,)))
     s_part = _g2_sum_tree(rsig)
 

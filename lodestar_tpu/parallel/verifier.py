@@ -82,11 +82,13 @@ def batch_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     Returns scalar bool.
     """
     n = pk_x.shape[0]
-    # r_i·pk_i (G1, projective out of the scan — no inversion); windowed
-    # ladders: ~half the group adds of the bit ladder for 64-bit scalars
-    rpk = g1.scalar_mul_windowed(r_bits, (pk_x, pk_y))
+    # r_i·pk_i (G1, projective out of the scan — no inversion). Bit
+    # ladders, NOT the windowed variant: measured on v5e (tools/win_check)
+    # the 2^4-window table selects cost more than the saved adds (307 vs
+    # 262 ms at 512 lanes for G2) and XLA compile time grows ~30x.
+    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
     # Σ r_i·sig_i (G2): per-lane scalar mul, mask padding to infinity, tree sum
-    rsig = g2.scalar_mul_windowed(r_bits, (sig_x, sig_y))
+    rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
     rsig = g2.select(valid, rsig, g2.infinity((n,)))
     s = _g2_sum_tree(rsig)
     s_inf = g2.is_infinity(s)
