@@ -324,6 +324,8 @@ class BeaconChain:
             block_delay_sec=block_delay,
         )
         # per-attestation fork-choice votes (importBlock.ts:88-130)
+        monitor = getattr(self, "validator_monitor", None)
+        monitored = monitor.monitored if monitor is not None else set()
         for att in block.body.attestations:
             try:
                 indices = get_attesting_indices(
@@ -332,8 +334,40 @@ class BeaconChain:
                 self.fork_choice.on_attestation(
                     indices, bytes(att.data.beacon_block_root), att.data.target.epoch
                 )
+                if monitored and monitored.intersection(int(i) for i in indices):
+                    spe = self.preset.SLOTS_PER_EPOCH
+                    target_root = self.fork_choice.get_ancestor(
+                        block_root, int(att.data.target.epoch) * spe
+                    )
+                    head_at_slot = self.fork_choice.get_ancestor(
+                        block_root, int(att.data.slot)
+                    )
+                    monitor.on_attestation_included(
+                        int(att.data.target.epoch),
+                        indices,
+                        int(block.slot) - int(att.data.slot),
+                        target_correct=target_root == bytes(att.data.target.root),
+                        head_correct=head_at_slot
+                        == bytes(att.data.beacon_block_root),
+                    )
             except Exception:
                 continue
+        if monitored:
+            epoch = int(block.slot) // self.preset.SLOTS_PER_EPOCH
+            monitor.on_block_proposed(epoch, int(block.proposer_index))
+            agg = getattr(block.body, "sync_aggregate", None)
+            if agg is not None:
+                pk_to_idx = post.epoch_ctx.pubkey_to_index
+                included = [
+                    pk_to_idx.get(bytes(pk), -1)
+                    for pk, bit in zip(
+                        post.state.current_sync_committee.pubkeys,
+                        list(agg.sync_committee_bits),
+                    )
+                    if bit
+                ] if hasattr(post.state, "current_sync_committee") else []
+                if included:
+                    monitor.on_sync_signature_included(epoch, included)
         # light-client data: the sync aggregate in this block signs its
         # parent (reference: lightClientServer.onImportBlockHead)
         if hasattr(block.body, "sync_aggregate"):
@@ -451,6 +485,21 @@ class BeaconChain:
     def on_gossip_attestation(self, attestation, data_root: bytes) -> None:
         with self.import_lock:
             self.attestation_pool.add(attestation, data_root)
+        monitor = getattr(self, "validator_monitor", None)
+        if monitor is not None and monitor.monitored:
+            try:
+                indices = get_attesting_indices(
+                    self.head_state, attestation.data, attestation.aggregation_bits
+                )
+                delay = self.clock.time_fn() - self.clock.time_at_slot(
+                    int(attestation.data.slot)
+                )
+                for idx in indices:
+                    monitor.on_gossip_attestation(
+                        int(attestation.data.target.epoch), int(idx), delay
+                    )
+            except Exception:
+                pass
 
     def on_aggregated_attestation(self, attestation, data_root: bytes) -> None:
         with self.import_lock:
@@ -469,6 +518,11 @@ class BeaconChain:
                 bytes(attestation.data.beacon_block_root),
                 attestation.data.target.epoch,
             )
+            monitor = getattr(self, "validator_monitor", None)
+            if monitor is not None and monitor.monitored:
+                monitor.on_attestation_in_aggregate(
+                    int(attestation.data.target.epoch), indices
+                )
         except Exception:
             pass
 

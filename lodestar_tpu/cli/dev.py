@@ -54,6 +54,15 @@ def run_dev(args) -> int:
     service = ValidatorService(config, types, chain, store)
 
     metrics = create_beacon_metrics()
+    chain.metrics = metrics
+    # per-validator duty monitor over the local keys (reference
+    # validatorMonitor: epoch-end duty summaries + metrics)
+    from ..metrics.validator_monitor import ValidatorMonitor
+
+    monitor = ValidatorMonitor(metrics.registry)
+    for i in range(args.validators):
+        monitor.register_validator(i)
+    chain.validator_monitor = monitor
     api_server = None
     metrics_server = None
     if args.rest:
@@ -73,6 +82,16 @@ def run_dev(args) -> int:
             signed = service.propose_block_if_due(slot)
             dt = time.perf_counter() - t0  # produce+import only
             service.attest_if_due(slot)
+            if slot % preset.SLOTS_PER_EPOCH == 0:
+                epoch_now = slot // preset.SLOTS_PER_EPOCH
+                # summarize an epoch only after its inclusion window fully
+                # closed (attestations from epoch e can land early in e+1);
+                # stamp current balances onto the epoch being closed
+                if epoch_now >= 2:
+                    monitor.on_balances(
+                        epoch_now - 2, chain.head_state.state.balances
+                    )
+                    monitor.log_epoch(epoch_now - 2, log)
             metrics.head_slot.set(chain.head_state.state.slot)
             metrics.current_justified_epoch.set(chain.justified_checkpoint[0])
             metrics.finalized_epoch.set(chain.finalized_checkpoint[0])

@@ -144,6 +144,12 @@ class GossipHandlers:
                 chain.on_aggregated_attestation(
                     signed_agg.message.aggregate, result.data_root
                 )
+                monitor = getattr(chain, "validator_monitor", None)
+                if monitor is not None:
+                    monitor.on_aggregate_published(
+                        int(signed_agg.message.aggregate.data.target.epoch),
+                        int(signed_agg.message.aggregator_index),
+                    )
             return _ACTION_TO_RESULT[result.action]
 
         if t is GossipType.voluntary_exit:
@@ -184,6 +190,12 @@ class GossipHandlers:
                     # of its bits from this first-seen message
                     for pos in result.positions or [result.attesting_index or 0]:
                         pool.add(msg, topic.subnet, pos)
+                monitor = getattr(chain, "validator_monitor", None)
+                if monitor is not None:
+                    spe = chain.preset.SLOTS_PER_EPOCH
+                    monitor.on_sync_committee_message(
+                        int(msg.slot) // spe, int(msg.validator_index)
+                    )
             return _ACTION_TO_RESULT[result.action]
 
         if t is GossipType.sync_committee_contribution_and_proof:

@@ -61,8 +61,12 @@ class BeaconNode:
             controller = FileDb(opts.datadir) if opts.datadir else MemoryDb()
         self.db = BeaconDb(types, controller)
 
-        # 2. metrics
+        # 2. metrics + per-validator monitor (reference validatorMonitor
+        # wired at node init; register indices via monitor_validators())
         self.metrics = create_beacon_metrics()
+        from ..metrics.validator_monitor import ValidatorMonitor
+
+        self.validator_monitor = ValidatorMonitor(self.metrics.registry)
 
         # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread)
         if opts.tpu_verifier:
@@ -80,6 +84,7 @@ class BeaconNode:
             execution_engine=opts.execution_engine,
         )
         self.chain.metrics = self.metrics
+        self.chain.validator_monitor = self.validator_monitor
 
         # 3b. eth1 deposit follower (live JSON-RPC or mock; None = none)
         self.eth1_tracker = None
@@ -124,6 +129,12 @@ class BeaconNode:
 
     # -- slot driving --------------------------------------------------------
 
+    def monitor_validators(self, indices) -> None:
+        """Register validator indices for per-duty tracking (reference
+        --monitoredValidators flag → validatorMonitor)."""
+        for i in indices:
+            self.validator_monitor.register_validator(int(i))
+
     def on_clock_slot(self, slot: int) -> None:
         """Per-slot housekeeping: clock, fork-choice time, prepared state,
         metrics, status line."""
@@ -153,6 +164,14 @@ class BeaconNode:
         m.op_pool_size.set(
             len(self.chain.op_pool.attester_slashings), kind="attester_slashings"
         )
+        spe = self.config.preset.SLOTS_PER_EPOCH
+        if slot % spe == 0 and self.validator_monitor.monitored:
+            epoch_now = slot // spe
+            if epoch_now >= 2:
+                self.validator_monitor.on_balances(
+                    epoch_now - 2, self.chain.head_state.state.balances
+                )
+                self.validator_monitor.log_epoch(epoch_now - 2, self.log)
         stats = getattr(self.db.db, "stats", None)
         if callable(stats):
             st = stats()

@@ -105,3 +105,46 @@ def test_network_metrics_exported_live():
                 await n.stop()
 
     asyncio.run(asyncio.wait_for(main(), 90.0))
+
+
+def test_validator_monitor_tracks_duties():
+    """Expanded ValidatorMonitor (reference validatorMonitor.ts): gossip
+    sightings, inclusions with distance/correctness, proposals,
+    aggregates, sync signatures, balances, epoch rollup + log lines."""
+    from lodestar_tpu.metrics.registry import MetricsRegistry
+    from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+
+    r = MetricsRegistry()
+    m = ValidatorMonitor(r)
+    for i in (1, 2, 3):
+        m.register_validator(i)
+
+    m.on_gossip_attestation(0, 1, delay_sec=0.5)
+    m.on_attestation_included(0, [1, 2], 1, target_correct=True, head_correct=False)
+    m.on_attestation_included(0, [1], 3, target_correct=False, head_correct=True)
+    m.on_aggregate_published(0, 2)
+    m.on_block_proposed(0, 3)
+    m.on_sync_committee_message(0, 1)
+    m.on_sync_signature_included(0, [1])
+    m.on_balances(0, [0, 32_000_000_000, 31_500_000_000, 32_100_000_000])
+
+    out = m.summarize_epoch(0)
+    assert out[1].attestation_included and out[1].inclusion_distance == 1
+    assert out[1].target_correct and out[1].head_correct  # OR across inclusions
+    assert out[1].sync_signatures == 1 and out[1].sync_signatures_included == 1
+    assert out[2].aggregates_published == 1
+    assert out[3].blocks_proposed == 1 and not out[3].attestation_included
+    assert out[1].balance_gwei == 32_000_000_000
+
+    # epoch log lines render for operators
+    class _Cap:
+        lines = []
+
+        def info(self, fmt, *args):
+            _Cap.lines.append(fmt % args)
+
+    m2 = ValidatorMonitor(r)
+    m2.register_validator(9)
+    m2.on_block_proposed(1, 9)
+    m2.log_epoch(1, _Cap())
+    assert any("v9" in l and "props=1" in l for l in _Cap.lines)
