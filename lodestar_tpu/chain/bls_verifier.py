@@ -49,33 +49,65 @@ class CpuBlsVerifier:
 
 
 class DeviceBlsVerifier:
-    """Device-tier verifier over the XLA batch kernels."""
+    """Device-tier verifier over the XLA batch kernels.
+
+    LODESTAR_TPU_PROFILE=<dir> wraps every dispatch in a
+    `jax.profiler.TraceAnnotation` and starts an XLA profiler trace into
+    <dir> on first use — the SURVEY §5 tracing hook at the verifier
+    boundary (view with TensorBoard/XProf)."""
 
     def __init__(self, buckets: tuple[int, ...] = (4, 16, 64, MAX_SIGNATURE_SETS_PER_JOB)):
+        import os
+
         from ..parallel.verifier import TpuBlsVerifier
 
         self._inner = TpuBlsVerifier(buckets=buckets)
         self.max_sets_per_job = buckets[-1]
+        self._profile_dir = os.environ.get("LODESTAR_TPU_PROFILE")
+        self._profiling = False
+
+    def _annotate(self, label: str):
+        import contextlib
+
+        if not self._profile_dir:
+            return contextlib.nullcontext()
+        import jax
+
+        if not self._profiling:
+            jax.profiler.start_trace(self._profile_dir)
+            self._profiling = True
+        return jax.profiler.TraceAnnotation(label)
+
+    def stop_profiling(self) -> None:
+        if self._profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     def verify_signature_sets(self, sets) -> bool:
         sets = list(sets)
         if not sets:
             return False
         # chunk oversized batches (reference chunkifyMaximizeChunkSize)
-        for i in range(0, len(sets), self.max_sets_per_job):
-            if not self._inner.verify_signature_sets(sets[i : i + self.max_sets_per_job]):
-                return False
-        return True
+        with self._annotate(f"bls_verify_batch/{len(sets)}"):
+            for i in range(0, len(sets), self.max_sets_per_job):
+                if not self._inner.verify_signature_sets(
+                    sets[i : i + self.max_sets_per_job]
+                ):
+                    return False
+            return True
 
     def verify_signature_sets_individual(self, sets) -> list[bool]:
         sets = list(sets)
         out: list[bool] = []
-        for i in range(0, len(sets), self.max_sets_per_job):
-            out.extend(
-                self._inner.verify_signature_sets_individual(
-                    sets[i : i + self.max_sets_per_job]
+        with self._annotate(f"bls_verify_individual/{len(sets)}"):
+            for i in range(0, len(sets), self.max_sets_per_job):
+                out.extend(
+                    self._inner.verify_signature_sets_individual(
+                        sets[i : i + self.max_sets_per_job]
+                    )
                 )
-            )
         return out
 
 

@@ -80,6 +80,12 @@ class BeaconChain:
         self._verify_pool = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="blockverify"
         )
+        # irrecoverable-fault escalation (reference ProcessShutdownCallback
+        # + faultInspectionWindow/allowedFaults, chain.ts:121-123)
+        self.process_shutdown_callback = None
+        self.fault_inspection_window_slots = 32
+        self.allowed_faults = 5
+        self._fault_slots: list[int] = []
 
         cached = CachedBeaconState(config, anchor_state, self.preset)
         self.head_state = cached
@@ -451,11 +457,41 @@ class BeaconChain:
             )
 
     def update_head(self) -> bytes:
-        self.head_root = self.fork_choice.update_head()
+        try:
+            self.head_root = self.fork_choice.update_head()
+        except Exception:
+            # fork-choice head selection failing is the reference's
+            # irrecoverable class (chain.ts:121-123): count it against
+            # the fault window and escalate to process shutdown when the
+            # budget is spent — a node that cannot pick a head must not
+            # keep attesting on a stale one
+            self._register_irrecoverable_fault()
+            raise
         head_state = self.state_cache.get_by_block_root(self.head_root)
         if head_state is not None:
             self.head_state = head_state
         return self.head_root
+
+    def _register_irrecoverable_fault(self) -> None:
+        """faultInspectionWindow/allowedFaults semantics (reference
+        BeaconChain opts + ProcessShutdownCallback): more than
+        ALLOWED_FAULTS head-selection failures within the sliding
+        FAULT_INSPECTION_WINDOW_SLOTS triggers the shutdown callback
+        (wired by the CLI to stop the process)."""
+        now_slot = self.clock.current_slot
+        window = self.fault_inspection_window_slots
+        allowed = self.allowed_faults
+        self._fault_slots.append(now_slot)
+        self._fault_slots = [s for s in self._fault_slots if s >= now_slot - window]
+        cb = self.process_shutdown_callback
+        if cb is not None and len(self._fault_slots) > allowed:
+            import logging
+
+            logging.getLogger(__name__).critical(
+                "%d fork-choice faults within %d slots: requesting shutdown",
+                len(self._fault_slots), window,
+            )
+            cb("irrecoverable fork-choice errors")
 
     def _notify_forkchoice_to_engine(self) -> None:
         """Mirror the beacon head/finalized into the EL (reference:

@@ -147,6 +147,14 @@ def run_beacon(args) -> int:
 
     signal.signal(signal.SIGINT, _sigint)
 
+    # irrecoverable fork-choice faults force an orderly exit (reference
+    # ProcessShutdownCallback wired in cmds/beacon/handler.ts:43-46)
+    def _process_shutdown(reason: str) -> None:
+        log.critical("process shutdown requested: %s", reason)
+        stop["flag"] = True
+
+    node.chain.process_shutdown_callback = _process_shutdown
+
     if args.port:
         return _run_networked(args, node, config, types, stop, log)
 

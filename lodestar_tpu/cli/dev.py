@@ -122,6 +122,9 @@ def run_dev(args) -> int:
             return 1
         return 0
     finally:
+        stopper = getattr(verifier, "stop_profiling", None)
+        if callable(stopper):
+            stopper()  # flush the XLA trace (LODESTAR_TPU_PROFILE)
         if api_server:
             api_server.close()
         if metrics_server:

@@ -67,6 +67,9 @@ class BeaconNode:
         from ..metrics.validator_monitor import ValidatorMonitor
 
         self.validator_monitor = ValidatorMonitor(self.metrics.registry)
+        from ..metrics.gc_stats import install_gc_metrics
+
+        install_gc_metrics(self.metrics.registry)
 
         # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread)
         if opts.tpu_verifier:
@@ -228,5 +231,8 @@ class BeaconNode:
             self.api_server.close()
         if self.metrics_server:
             self.metrics_server.close()
+        stopper = getattr(self.chain.bls, "stop_profiling", None)
+        if callable(stopper):
+            stopper()  # flush the XLA trace (LODESTAR_TPU_PROFILE)
         self.chain._verify_pool.shutdown(wait=False)
         self.db.close()

@@ -69,30 +69,48 @@ class CurveOps:
         return (s(cond, p[0], q[0]), s(cond, p[1], q[1]), s(cond, p[2], q[2]))
 
     # -- group law (complete, branchless) -----------------------------------
+    #
+    # LATENCY DISCIPLINE (round-2 profile, tools/kernel_profile.py): the
+    # scalar ladders are latency-bound — at 4096 lanes each Montgomery
+    # multiply's sequential cost dominates, and wider stacked multiplies
+    # are ~4× cheaper per lane. So every formula below evaluates its
+    # INDEPENDENT products as ONE stacked F.mul call: RCB16 addition runs
+    # as 3 stacked calls (6+2+6 products) instead of 14 sequential ones,
+    # doubling as 3 (4+1+4) instead of ~10.
+
+    def _mulstack(self, lhs, rhs):
+        """One stacked field multiply over a new leading axis (operands
+        broadcast to a common shape first — constants like b3 ride along)."""
+        F = self.F
+        shape = jnp.broadcast_shapes(*(a.shape for a in lhs), *(b.shape for b in rhs))
+        lhs = [jnp.broadcast_to(a, shape) for a in lhs]
+        rhs = [jnp.broadcast_to(b, shape) for b in rhs]
+        out = F.mul(jnp.stack(lhs, axis=0), jnp.stack(rhs, axis=0))
+        return [out[i] for i in range(len(lhs))]
 
     def add(self, p, q):
         """RCB16 Algorithm 7 (a=0): complete projective addition."""
         F, b3 = self.F, self.b3
         x1, y1, z1 = p
         x2, y2, z2 = q
-        t0 = F.mul(x1, x2)
-        t1 = F.mul(y1, y2)
-        t2 = F.mul(z1, z2)
-        t3 = F.mul(F.add(x1, y1), F.add(x2, y2))
-        t3 = F.sub(t3, F.add(t0, t1))  # x1y2 + x2y1
-        t4 = F.mul(F.add(y1, z1), F.add(y2, z2))
-        t4 = F.sub(t4, F.add(t1, t2))  # y1z2 + y2z1
-        x3 = F.mul(F.add(x1, z1), F.add(x2, z2))
-        y3 = F.sub(x3, F.add(t0, t2))  # x1z2 + x2z1
+        # stage A: all 6 cross products at once
+        t0, t1, t2, u, v, w = self._mulstack(
+            [x1, y1, z1, F.add(x1, y1), F.add(y1, z1), F.add(x1, z1)],
+            [x2, y2, z2, F.add(x2, y2), F.add(y2, z2), F.add(x2, z2)],
+        )
+        t3 = F.sub(u, F.add(t0, t1))   # x1y2 + x2y1
+        t4 = F.sub(v, F.add(t1, t2))   # y1z2 + y2z1
+        y3p = F.sub(w, F.add(t0, t2))  # x1z2 + x2z1
         x3 = F.add(F.add(t0, t0), t0)  # 3·x1x2
-        t2 = F.mul(b3, t2)
-        z3 = F.add(t1, t2)
-        t1 = F.sub(t1, t2)
-        y3 = F.mul(b3, y3)
-        x3_out = F.sub(F.mul(t3, t1), F.mul(t4, y3))
-        y3_out = F.add(F.mul(y3, x3), F.mul(t1, z3))
-        z3_out = F.add(F.mul(z3, t4), F.mul(x3, t3))
-        return (x3_out, y3_out, z3_out)
+        # stage B: the two b3 scalings
+        t2b, y3 = self._mulstack([b3, b3], [t2, y3p])
+        z3 = F.add(t1, t2b)
+        t1 = F.sub(t1, t2b)
+        # stage C: the 6 output products
+        a, b, c, d, e, f = self._mulstack(
+            [t3, t4, y3, t1, z3, x3], [t1, y3, x3, z3, t4, t3]
+        )
+        return (F.sub(a, b), F.add(c, d), F.add(e, f))
 
     def add_mixed(self, p, q_affine):
         """RCB16 Algorithm 8 (a=0): complete mixed addition, Z2 = 1.
@@ -103,46 +121,47 @@ class CurveOps:
         F, b3 = self.F, self.b3
         x1, y1, z1 = p
         x2, y2 = q_affine
-        t0 = F.mul(x1, x2)
-        t1 = F.mul(y1, y2)
-        t3 = F.mul(F.add(x2, y2), F.add(x1, y1))
-        t3 = F.sub(t3, F.add(t0, t1))
-        t4 = F.add(F.mul(x2, z1), x1)  # x1z2 + x2z1 with z2=1
-        y3 = t4
-        t4 = F.add(F.mul(y2, z1), y1)  # y1z2 + y2z1
+        # stage A: cross products + the b3·z1 scaling are all independent
+        t0, t1, u, xz, yz, t2b = self._mulstack(
+            [x1, y1, F.add(x1, y1), x2, y2, b3],
+            [x2, y2, F.add(x2, y2), z1, z1, z1],
+        )
+        t3 = F.sub(u, F.add(t0, t1))
+        y3p = F.add(xz, x1)            # x1 + x2·z1
+        t4 = F.add(yz, y1)             # y1 + y2·z1
         x3 = F.add(F.add(t0, t0), t0)
-        t2 = F.mul(b3, z1)
-        z3 = F.add(t1, t2)
-        t1 = F.sub(t1, t2)
-        y3 = F.mul(b3, y3)
-        x3_out = F.sub(F.mul(t3, t1), F.mul(t4, y3))
-        y3_out = F.add(F.mul(y3, x3), F.mul(t1, z3))
-        z3_out = F.add(F.mul(z3, t4), F.mul(x3, t3))
-        return (x3_out, y3_out, z3_out)
+        z3 = F.add(t1, t2b)
+        t1 = F.sub(t1, t2b)
+        # stage B: b3 scaling of y3p
+        y3 = F.mul(b3, y3p)
+        # stage C: outputs
+        a, b, c, d, e, f = self._mulstack(
+            [t3, t4, y3, t1, z3, x3], [t1, y3, x3, z3, t4, t3]
+        )
+        return (F.sub(a, b), F.add(c, d), F.add(e, f))
 
     def double(self, p):
         """RCB16 Algorithm 9 (a=0): complete projective doubling."""
         F, b3 = self.F, self.b3
         x, y, z = p
-        t0 = F.mul(y, y)
-        z3 = F.add(t0, t0)
-        z3 = F.add(z3, z3)
-        z3 = F.add(z3, z3)  # 8y²
-        t1 = F.mul(y, z)
-        t2 = F.mul(z, z)
-        t2 = F.mul(b3, t2)
-        x3 = F.mul(t2, z3)
-        y3 = F.add(t0, t2)
-        z3 = F.mul(t1, z3)
-        t1 = F.add(t2, t2)
-        t2 = F.add(t1, t2)
-        t0 = F.sub(t0, t2)
-        y3 = F.mul(t0, y3)
+        # stage A: the 4 independent squares/products
+        t0, t1, t2, txy = self._mulstack([y, y, z, x], [y, z, z, y])
+        z8 = F.add(t0, t0)
+        z8 = F.add(z8, z8)
+        z8 = F.add(z8, z8)  # 8y²
+        # stage B: b3·z²
+        t2b = F.mul(b3, t2)
+        y3s = F.add(t0, t2b)
+        t1c = F.add(t2b, t2b)
+        t2c = F.add(t1c, t2b)
+        t0c = F.sub(t0, t2c)
+        # stage C: the 4 output products
+        x3, z3, y3, xt = self._mulstack(
+            [t2b, t1, t0c, t0c], [z8, z8, y3s, txy]
+        )
         y3 = F.add(x3, y3)
-        t1 = F.mul(x, y)
-        x3 = F.mul(t0, t1)
-        x3 = F.add(x3, x3)
-        return (x3, y3, z3)
+        xt = F.add(xt, xt)
+        return (xt, y3, z3)
 
     def neg(self, p):
         return (p[0], self.F.neg(p[1]), p[2])

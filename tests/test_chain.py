@@ -311,3 +311,27 @@ def test_regen_queue_bounded(chain_env):
             chain.regen.get_state_for_block(b"\x77" * 32)
     finally:
         chain.regen._pending = 0
+
+
+def test_irrecoverable_fault_window_triggers_shutdown(chain_env):
+    """Reference ProcessShutdownCallback (chain.ts:121-123): more than
+    allowed_faults head-selection failures inside the inspection window
+    must invoke the shutdown callback; fewer must not."""
+    config, types, state = chain_env
+    chain = BeaconChain(config, types, state.copy())
+    calls = []
+    chain.process_shutdown_callback = calls.append
+    chain.allowed_faults = 2
+    chain.fault_inspection_window_slots = 10
+
+    def boom():
+        raise RuntimeError("no viable head")
+
+    chain.fork_choice.update_head = boom
+    for i in range(2):
+        with pytest.raises(RuntimeError):
+            chain.update_head()
+    assert calls == []  # within budget
+    with pytest.raises(RuntimeError):
+        chain.update_head()
+    assert calls and "irrecoverable" in calls[0]
