@@ -241,7 +241,7 @@ class BeaconChain:
             if m is not None and fut_sig is not None:
                 # wait beyond the STF, i.e. the non-overlapped signature tail
                 m.block_sig_seconds.observe(t_sig - t_stf)
-            fut_payload.result()  # raises BlockImportError on INVALID
+            payload_status = fut_payload.result()  # raises on INVALID
             if m is not None:
                 m.block_payload_seconds.observe(_time.monotonic() - t_sig)
                 m.block_import_seconds.observe(_time.monotonic() - t_start)
@@ -258,21 +258,57 @@ class BeaconChain:
                         pass
             raise
 
-        self._import_block(signed_block, block_root, post)
+        self._import_block(signed_block, block_root, post, payload_status)
         return block_root
 
-    def _verify_execution_payload(self, post, signed_block) -> None:
+    def _verify_execution_payload(self, post, signed_block):
+        """Returns the engine status (None = nothing to verify) so the
+        import records the right optimistic execution_status."""
         if self.execution_engine is None or not post.is_execution:
-            return
+            return None
         from ..execution.engine import ExecutePayloadStatus
         from ..state_transition.bellatrix import has_execution_payload
 
         body = signed_block.message.body
         if not has_execution_payload(body):
-            return  # pre-merge empty payload: nothing for the EL
+            return None  # pre-merge empty payload: nothing for the EL
         status = self.execution_engine.notify_new_payload(body.execution_payload)
         if status in (ExecutePayloadStatus.INVALID, ExecutePayloadStatus.INVALID_BLOCK_HASH):
+            # optimistic-sync invalidation: with a RESOLVABLE
+            # latestValidHash, ancestors after the LVH block (and their
+            # descendants) become non-viable (reference LVH walk —
+            # round-1 VERDICT fork-choice gap). An unresolvable LVH
+            # invalidates NOTHING extra: the offending block was never
+            # imported, and guessing would brick a valid head.
+            lvh = getattr(self.execution_engine, "last_latest_valid_hash", None)
+            lvh_root = self._block_root_of_payload(lvh) if lvh else None
+            if lvh_root is not None:
+                parent_root = bytes(signed_block.message.parent_root)
+                invalidated = self.fork_choice.proto.invalidate_payloads(
+                    parent_root, lvh_root
+                )
+                if invalidated:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "engine INVALID invalidated %d optimistic ancestors",
+                        len(invalidated),
+                    )
             raise BlockImportError(f"execution payload invalid: {status}")
+        return status
+
+    def _block_root_of_payload(self, block_hash: bytes) -> bytes | None:
+        """Beacon block root whose payload has `block_hash` (walks the hot
+        blocks; None when unknown — then only the offending head is
+        invalidated)."""
+        for root, signed in self.blocks.items():
+            if signed is None:
+                continue
+            body = signed.message.body
+            payload = getattr(body, "execution_payload", None)
+            if payload is not None and bytes(payload.block_hash) == block_hash:
+                return root
+        return None
 
     def _get_pre_state(self, signed_block) -> CachedBeaconState:
         """Pre-state via regen: cache fast path, replay fallback
@@ -284,7 +320,9 @@ class BeaconChain:
         except RegenError as e:
             raise BlockImportError(str(e)) from e
 
-    def _import_block(self, signed_block, block_root: bytes, post) -> None:
+    def _import_block(
+        self, signed_block, block_root: bytes, post, payload_status=None
+    ) -> None:
         block = signed_block.message
         state = post.state
         prev_finalized = self.fork_choice.store.finalized_checkpoint[0]
@@ -328,7 +366,13 @@ class BeaconChain:
             unrealized_justified_checkpoint=unrealized_j,
             unrealized_finalized_checkpoint=unrealized_f,
             block_delay_sec=block_delay,
+            execution_status=_exec_status_for_fork_choice(payload_status, post),
         )
+        if payload_status is not None and str(
+            getattr(payload_status, "value", payload_status)
+        ) == "VALID":
+            # a VALID verdict confirms every optimistic ancestor too
+            self.fork_choice.proto.set_execution_valid(block_root)
         # per-attestation fork-choice votes (importBlock.ts:88-130)
         monitor = getattr(self, "validator_monitor", None)
         monitored = monitor.monitored if monitor is not None else set()
@@ -768,6 +812,16 @@ def _as_withdrawal(types, w):
             amount=_as_int(w.get("amount", 0)),
         )
     return w
+
+
+def _exec_status_for_fork_choice(payload_status, post) -> str:
+    """Engine verdict → proto-array execution_status (reference
+    getPostMergeExecStatus: VALID→valid, SYNCING/ACCEPTED→syncing
+    [optimistic import], no payload→pre_merge)."""
+    if payload_status is None or not post.is_execution:
+        return "pre_merge"
+    v = str(getattr(payload_status, "value", payload_status))
+    return "valid" if v == "VALID" else "syncing"
 
 
 def _anchor_block_root(state) -> bytes:

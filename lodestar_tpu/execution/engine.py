@@ -248,12 +248,25 @@ class ExecutionEngineHttp:
 
     def notify_new_payload(self, payload) -> ExecutePayloadStatus:
         """Accepts an SSZ ExecutionPayload container or a pre-built engine
-        JSON dict."""
+        JSON dict. The engine's latestValidHash (when present and nonzero)
+        is kept on `last_latest_valid_hash` for the caller's
+        optimistic-sync invalidation — the return shape stays a bare
+        status so every IExecutionEngine implementation agrees."""
         payload_json = (
             payload if isinstance(payload, dict) else payload_to_engine_json(payload)
         )
         version = "V2" if "withdrawals" in payload_json else "V1"
         result = self._call(f"engine_newPayload{version}", [payload_json])
+        lvh_hex = result.get("latestValidHash")
+        lvh = (
+            bytes.fromhex(lvh_hex.removeprefix("0x"))
+            if isinstance(lvh_hex, str)
+            else None
+        )
+        # the zero hash means "no valid ancestor known" (engine API): no LVH
+        self.last_latest_valid_hash = (
+            lvh if lvh and lvh != b"\x00" * 32 else None
+        )
         return ExecutePayloadStatus(result["status"])
 
     def notify_forkchoice_update(

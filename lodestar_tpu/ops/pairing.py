@@ -38,45 +38,100 @@ X_ABS = abs(X_PARAM)
 _X_BITS_TAIL = np.array([int(b) for b in bin(X_ABS)[3:]], dtype=np.int32)
 
 
-def _line_dbl(t, xp_neg, yp, zp):
-    """Tangent-line coefficients at T (projective, on twist), evaluated at
-    P = (xp, yp) ∈ G1 affine, line scaled by 2YZ²·w³:
+# one stacked fp2 multiply over a new leading axis — the latency
+# discipline of ops/points.py applied to the Miller step (same helper:
+# g2 is the CurveOps instance whose field is fp2)
+def _stack_mul(lhs, rhs):
+    return g2._mulstack(lhs, rhs)
+
+
+def _lift_fp(a):
+    """Fp element (..., 32) → Fp2 with zero imaginary part, so Fp scalings
+    can ride the stacked fp2 multiplies."""
+    import jax.numpy as jnp
+
+    return jnp.stack([a, jnp.zeros_like(a)], axis=-2)
+
+
+def _line_and_double(t, xp_neg2, yp2, zp2, b3):
+    """Fused tangent line + point doubling for the Miller step.
+
+    Line (scaled by 2YZ²·w³, ×Zp for projective P):
         l0 = 3X³ − 2Y²Z,  l1 = 3X²Z·(−xp),  l2 = 2YZ²·yp
-    (l = l0 + l1·w² + l2·w³). Expects xp_neg = −xp precomputed.
+    Double: RCB16 Algorithm 9 (a=0) on the twist.
 
-    When P is projective (zp is not None, xp_neg = −Xp, yp = Yp), the whole
-    line is additionally scaled by Zp ∈ Fp — a subfield factor annihilated
-    by the final exponentiation (x^(p⁶−1) = 1 for x ∈ Fp), so projective-P
-    pairings cost one extra Fp2·Fp mul per step instead of a per-lane field
-    inversion. This is what lets the batch verifier feed r_i·pk_i straight
-    out of the scalar-mul scan."""
+    The two share X², Y², Z², YZ, XY — everything runs as THREE stacked
+    fp2 multiplies (5+5+7 products) instead of ~9 sequential ones
+    (profile: the Miller scan body is latency-bound like the ladders).
+    xp_neg2/yp2/zp2 are the G1 evaluation point lifted to Fp2 (zero
+    imaginary part); zp2 is None for affine P."""
     x, y, z = t
-    xx = fp2.mul(x, x)
-    yy = fp2.mul(y, y)
-    three_xx = fp2.add(fp2.add(xx, xx), xx)
-    l0 = fp2.sub(fp2.mul(three_xx, x), fp2.double(fp2.mul(yy, z)))
-    if zp is not None:
-        l0 = fp2.mul_fp(l0, zp)
-    l1 = fp2.mul_fp(fp2.mul(three_xx, z), xp_neg)
-    l2 = fp2.mul_fp(fp2.double(fp2.mul(fp2.mul(y, z), z)), yp)
-    return l0, l1, l2
+    # stage A: shared quadratic monomials
+    xx, yy, zz, yz, xy = _stack_mul([x, y, z, y, x], [x, y, z, z, y])
+    # stage B: cubics + the b3 scaling
+    xxx, yyz, xxz, yzz, t2b = _stack_mul(
+        [xx, yy, xx, yz, b3], [x, z, z, z, zz]
+    )
+    l0 = fp2.sub(
+        fp2.add(fp2.add(xxx, xxx), xxx), fp2.double(yyz)
+    )  # 3X³ − 2Y²Z
+    three_xxz = fp2.add(fp2.add(xxz, xxz), xxz)
+    two_yzz = fp2.double(yzz)
+    z8 = fp2.double(fp2.double(fp2.double(yy)))  # 8Y²
+    y3s = fp2.add(yy, t2b)
+    t0c = fp2.sub(yy, fp2.add(fp2.add(t2b, t2b), t2b))
+    # stage C: line evaluations + double outputs
+    lhs = [three_xxz, two_yzz, t2b, yz, t0c, t0c]
+    rhs = [xp_neg2, yp2, z8, z8, y3s, xy]
+    if zp2 is not None:
+        lhs.append(l0)
+        rhs.append(zp2)
+    out = _stack_mul(lhs, rhs)
+    l1, l2, x3, z3, y3m, xt = out[:6]
+    if zp2 is not None:
+        l0 = out[6]
+    t_next = (fp2.double(xt), fp2.add(x3, y3m), z3)
+    return l0, l1, l2, t_next
 
 
-def _line_add(t, q_aff, xp_neg, yp, zp):
-    """Chord-line coefficients through T and affine Q, evaluated at P,
-    scaled by H·w³ with θ = Y − yq·Z, H = X − xq·Z:
-        l0 = θ·xq − yq·H,  l1 = θ·(−xp),  l2 = H·yp.
-    Projective P handled as in `_line_dbl` (l0 scaled by Zp)."""
+def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
+    """Fused chord line + mixed addition T+Q for the Miller step.
+
+    Line (scaled by H·w³, ×Zp for projective P) with θ = Y − yq·Z,
+    H = X − xq·Z:  l0 = θ·xq − yq·H,  l1 = θ·(−xp),  l2 = H·yp.
+    Addition: RCB16 Algorithm 8 (a=0), Q affine. Three stacked fp2
+    multiplies (6+6+7) instead of ~9 sequential."""
     x, y, z = t
     xq, yq = q_aff
-    theta = fp2.sub(y, fp2.mul(yq, z))
-    h = fp2.sub(x, fp2.mul(xq, z))
-    l0 = fp2.sub(fp2.mul(theta, xq), fp2.mul(yq, h))
-    if zp is not None:
-        l0 = fp2.mul_fp(l0, zp)
-    l1 = fp2.mul_fp(theta, xp_neg)
-    l2 = fp2.mul_fp(h, yp)
-    return l0, l1, l2
+    # stage A: line + addition cross products (xq·z / yq·z shared)
+    t0, t1, u, xqz, yqz, b3z = _stack_mul(
+        [x, y, fp2.add(x, y), xq, yq, b3], [xq, yq, fp2.add(xq, yq), z, z, z]
+    )
+    theta = fp2.sub(y, yqz)
+    h = fp2.sub(x, xqz)
+    t3 = fp2.sub(u, fp2.add(t0, t1))
+    y3p = fp2.add(xqz, x)
+    t4 = fp2.add(yqz, y)
+    x3 = fp2.add(fp2.add(t0, t0), t0)
+    z3 = fp2.add(t1, b3z)
+    t1m = fp2.sub(t1, b3z)
+    # stage B: line products + the b3·y3p scaling
+    th_xq, yq_h, l1, l2, y3 = _stack_mul(
+        [theta, yq, theta, h, b3], [xq, h, xp_neg2, yp2, y3p]
+    )
+    l0 = fp2.sub(th_xq, yq_h)
+    # stage C: addition outputs (+ optional l0·zp)
+    lhs = [t3, t4, y3, t1m, z3, x3]
+    rhs = [t1m, y3, x3, z3, t4, t3]
+    if zp2 is not None:
+        lhs.append(l0)
+        rhs.append(zp2)
+    out = _stack_mul(lhs, rhs)
+    a, b, c, d, e, f = out[:6]
+    if zp2 is not None:
+        l0 = out[6]
+    t_next = (fp2.sub(a, b), fp2.add(c, d), fp2.add(e, f))
+    return l0, l1, l2, t_next
 
 
 def miller_loop(p_aff, q_aff):
@@ -110,22 +165,27 @@ def _miller_loop_impl(xp, yp, zp, xq, yq):
         zp = jnp.broadcast_to(zp, batch + zp.shape[-1:])
     xq = jnp.broadcast_to(xq, batch + xq.shape[-2:])
     yq = jnp.broadcast_to(yq, batch + yq.shape[-2:])
-    xp_neg = fp.neg(xp)
+    # lift the G1 evaluation point into Fp2 once so its scalings join the
+    # fused stacked multiplies of _line_and_double/_line_and_add
+    xp_neg2 = _lift_fp(fp.neg(xp))
+    yp2 = _lift_fp(yp)
+    zp2 = None if zp is None else _lift_fp(zp)
+    b3 = g2.b3
 
     t0 = g2.from_affine(xq, yq)
     f0 = fp12.one(batch)
 
     def step(carry, bit):
         t, f = carry
-        l0, l1, l2 = _line_dbl(t, xp_neg, yp, zp)
+        l0, l1, l2, t = _line_and_double(t, xp_neg2, yp2, zp2, b3)
         f = fp12.mul_by_line(fp12.square(f), l0, l1, l2)
-        t = g2.double(t)
 
         def with_add(operand):
             t_in, f_in = operand
-            a0, a1, a2 = _line_add(t_in, (xq, yq), xp_neg, yp, zp)
+            a0, a1, a2, t_out = _line_and_add(
+                t_in, (xq, yq), xp_neg2, yp2, zp2, b3
+            )
             f_out = fp12.mul_by_line(f_in, a0, a1, a2)
-            t_out = g2.add_mixed(t_in, (xq, yq))
             return t_out, f_out
 
         t, f = lax.cond(bit != 0, with_add, lambda o: o, (t, f))
