@@ -94,3 +94,76 @@ def test_unknown_block_sync_resolves_parents(two_nodes):
     root = ub.resolve(target, verify_signatures=False)
     assert root in node_c.blocks
     assert node_c.head_state.state.slot >= 5
+
+
+def test_segment_import_batches_signatures_once():
+    """process_block_segment verifies ALL of a segment's signature sets in
+    one verifier call (reference verifyBlocksSignatures batches ~8k sigs
+    per 64-block segment) and imports nothing when the batch fails."""
+    from tests.test_chain import _attest_head, _sign_block, _sk
+    from lodestar_tpu.bls import api as bls
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.params import DOMAIN_RANDAO
+    from lodestar_tpu.params.presets import MINIMAL
+    from lodestar_tpu.state_transition import interop_genesis_state, process_slots
+    from lodestar_tpu.state_transition.block import _epoch_signing_root
+    from lodestar_tpu.types import get_types
+
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(fork_config, types, 16, genesis_time=1_600_000_000)
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+
+    # producer chain builds a 6-block segment
+    producer = BeaconChain(config, types, state.copy())
+    segment = []
+    for slot in range(1, 7):
+        producer.clock.set_slot(slot)
+        trial = producer.head_state.copy()
+        if slot > trial.state.slot:
+            process_slots(trial, types, slot)
+        proposer = trial.epoch_ctx.get_beacon_proposer(slot)
+        reveal = _sk(proposer).sign(
+            _epoch_signing_root(slot // MINIMAL.SLOTS_PER_EPOCH,
+                                config.get_domain(DOMAIN_RANDAO, slot))
+        ).to_bytes()
+        block = producer.produce_block(slot, randao_reveal=reveal)
+        signed = _sign_block(config, types, block)
+        producer.process_block(signed, verify_signatures=False)
+        segment.append(signed)
+
+    class CountingVerifier:
+        calls = 0
+
+        def verify_signature_sets(self, sets):
+            CountingVerifier.calls += 1
+            return bls.verify_signature_sets(list(sets))
+
+        def verify_signature_sets_individual(self, sets):
+            return [bls.verify_signature_sets([s]) for s in sets]
+
+    importer = BeaconChain(
+        config, types, state.copy(), verifier=CountingVerifier()
+    )
+    importer.clock.set_slot(6)
+    roots = importer.process_block_segment(segment, verify_signatures=True)
+    assert len(roots) == 6
+    assert CountingVerifier.calls == 1  # the whole segment in ONE dispatch
+    assert importer.head_root == roots[-1]
+
+    # a tampered segment imports NOTHING
+    bad_segment = [s.copy() for s in segment]
+    bad_segment[3].signature = b"\x11" * 96
+    importer2 = BeaconChain(config, types, state.copy())
+    importer2.clock.set_slot(6)
+    import pytest as _pytest
+
+    from lodestar_tpu.chain.chain import BlockImportError
+
+    with _pytest.raises(BlockImportError):
+        importer2.process_block_segment(bad_segment, verify_signatures=True)
+    assert importer2.head_state.state.slot == 0
