@@ -52,6 +52,8 @@ SEEN_TTL = 120.0
 PRUNE_BACKOFF = 60.0
 FANOUT_TTL = 60.0
 MAX_IHAVE_PER_HEARTBEAT = 5000
+# per-peer IWANT service budget, reset each heartbeat (bandwidth-sink guard)
+MAX_IWANT_SERVED_PER_HEARTBEAT = 512
 
 log = get_logger("gossipsub")
 
@@ -139,6 +141,10 @@ class Gossipsub:
         rng: random.Random | None = None,
     ):
         self.peers: dict[str, PeerState] = {}
+        # per-peer IWANT messages served this heartbeat: lives on the
+        # ROUTER (not PeerState) so connection churn cannot reset it —
+        # mirroring how PeerScore retains scores across reconnects
+        self._iwant_served: dict[str, int] = {}
         self.subscriptions: set[str] = set()
         self.mesh: dict[str, set[str]] = {}
         self.fanout: dict[str, set[str]] = {}
@@ -325,8 +331,9 @@ class Gossipsub:
             await self._send(peer.peer_id, RPC(prune=prunes))
 
     async def _handle_gossip_control(self, peer: PeerState, rpc: RPC) -> None:
+        peer_score = self.score.score(peer.peer_id)  # once per RPC
         # IHAVE → request unseen ids (only from peers above gossip threshold)
-        if rpc.ihave and self.score.score(peer.peer_id) >= GOSSIP_THRESHOLD:
+        if rpc.ihave and peer_score >= GOSSIP_THRESHOLD:
             want = []
             for ih in rpc.ihave:
                 if ih.topic not in self.subscriptions:
@@ -334,15 +341,28 @@ class Gossipsub:
                 want.extend(mid for mid in ih.msg_ids if mid not in self.seen)
             if want:
                 await self._send(peer.peer_id, RPC(iwant=want[:MAX_IHAVE_PER_HEARTBEAT]))
-        # IWANT → serve from mcache
-        if rpc.iwant:
-            msgs = []
-            for mid in rpc.iwant[:MAX_IHAVE_PER_HEARTBEAT]:
-                entry = self.mcache.get(mid)
-                if entry is not None:
-                    msgs.append(entry)
-            if msgs:
-                await self._send(peer.peer_id, RPC(messages=msgs))
+        # IWANT → serve from mcache, gated on peer score and a per-peer
+        # per-heartbeat budget (round-1 advisor: without the quota a
+        # graylist-adjacent peer can re-request the whole cache every RPC
+        # and use the node as a bandwidth sink; the v1.1 spec expects
+        # IWANT service limits — reference gossipsub MAX_IWANT quota)
+        if rpc.iwant and peer_score >= GOSSIP_THRESHOLD:
+            budget = MAX_IWANT_SERVED_PER_HEARTBEAT - self._iwant_served.get(
+                peer.peer_id, 0
+            )
+            if budget > 0:
+                msgs = []
+                for mid in rpc.iwant:
+                    if len(msgs) >= budget:
+                        break  # budget counts SERVED messages, not ids
+                    entry = self.mcache.get(mid)
+                    if entry is not None:
+                        msgs.append(entry)
+                if msgs:
+                    self._iwant_served[peer.peer_id] = (
+                        self._iwant_served.get(peer.peer_id, 0) + len(msgs)
+                    )
+                    await self._send(peer.peer_id, RPC(messages=msgs))
 
     # -------------------------------------------------------------- heartbeat
 
@@ -367,6 +387,7 @@ class Gossipsub:
 
     async def heartbeat(self) -> None:
         now = self._time()
+        self._iwant_served.clear()  # refresh the per-heartbeat IWANT budgets
         if now - self._last_decay >= DECAY_INTERVAL:
             self.score.decay()
             self._last_decay = now

@@ -280,3 +280,65 @@ def test_prune_backoff_respected():
         assert grafts  # backoff expired → graft again
 
     run(main())
+
+
+def test_iwant_served_with_budget_and_score_gate():
+    """Round-1 advisor low: IWANT service is capped per peer per heartbeat
+    and gated on peer score — a bandwidth-sink peer cannot drain the
+    mcache repeatedly within one heartbeat."""
+
+    async def main():
+        from lodestar_tpu.network.gossip.encoding import compute_msg_id
+        from lodestar_tpu.network.gossip.gossipsub import (
+            MAX_IWANT_SERVED_PER_HEARTBEAT,
+        )
+
+        a = Gossipsub()
+        served = []
+
+        async def sink(data: bytes):
+            rpc = decode_rpc(data)
+            served.extend(rpc.messages)
+
+        a.add_peer("leech", sink, outbound=False)
+        await a.subscribe("t")
+        mids = []
+        n = MAX_IWANT_SERVED_PER_HEARTBEAT + 50
+        for i in range(n):
+            data = b"m%d" % i
+            mid = compute_msg_id("t", data)
+            a.mcache.put(mid, "t", data)
+            mids.append(mid)
+
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=list(mids))))
+        assert len(served) == MAX_IWANT_SERVED_PER_HEARTBEAT  # capped
+        # budget exhausted within the heartbeat: nothing more is served
+        served.clear()
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=list(mids))))
+        assert served == []
+        # reconnect churn must NOT refresh the budget mid-heartbeat
+        a.remove_peer("leech")
+        a.add_peer("leech", sink, outbound=False)
+        served.clear()
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=list(mids))))
+        assert served == []
+        # heartbeat refreshes the budget
+        await a.heartbeat()
+        served.clear()
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=list(mids[:4]))))
+        assert len(served) == 4
+        # the budget counts SERVED messages: uncached ids don't consume it
+        await a.heartbeat()
+        served.clear()
+        missing = [b"\x99" * 20] * MAX_IWANT_SERVED_PER_HEARTBEAT
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=missing + mids[4:8])))
+        assert len(served) == 4
+        # graylisted peers are not served at all
+        a.score.params.topics["t"] = TopicScoreParams(topic_weight=1.0)
+        for _ in range(50):
+            a.score.reject_message("leech", "t")
+        served.clear()
+        await a.on_rpc("leech", encode_rpc(RPC(iwant=list(mids[:4]))))
+        assert served == []
+
+    run(main())
