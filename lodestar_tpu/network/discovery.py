@@ -195,6 +195,13 @@ class Discovery(asyncio.DatagramProtocol):
         self.table = RoutingTable(enr.node_id)
         self.transport_udp: asyncio.DatagramTransport | None = None
         self._pending_pong: dict[str, asyncio.Future] = {}
+        # endpoint proof (anti-reflection): node_id -> addr that answered
+        # OUR ping with a valid PONG (discv5 WHOAREYOU-equivalent role)
+        self._endpoint_proven: dict[str, tuple] = {}
+        self._ping_addr: dict[str, tuple] = {}
+        # FINDNODEs held back until the challenge round-trip completes:
+        # node_id -> (addr, target_id) — answered from the PONG handler
+        self._pending_findnode: dict[str, tuple] = {}
         self._pending_nodes: dict[str, asyncio.Future] = {}
         self._known_keys: dict[str, bytes] = {}  # node_id → pubkey
         self._last_nonce: dict[str, int] = {}  # node_id → highest seen nonce
@@ -307,21 +314,35 @@ class Discovery(asyncio.DatagramProtocol):
                 if enr.node_id == node_id and enr.verify():
                     if self.table.update(enr):
                         self._notify(enr)
+                # endpoint proof: a valid PONG from the address we PINGed
+                # demonstrates the peer actually RECEIVES at that address
+                # (a spoofed source cannot complete the round trip).
+                # addr[:2]: IPv6 recvfrom yields 4-tuples; compare host+port.
+                expected = self._ping_addr.get(node_id)
+                if expected is not None and tuple(addr)[:2] == tuple(expected)[:2]:
+                    del self._ping_addr[node_id]  # pop ONLY on match: a
+                    # concurrent ping must not destroy a live challenge
+                    self._endpoint_proven[node_id] = tuple(addr)[:2]
+                    held = self._pending_findnode.pop(node_id, None)
+                    if held is not None:
+                        self._answer_findnode(held[0], held[1])
                 fut = self._pending_pong.pop(node_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
             elif ptype == _FINDNODE:
                 target = body[:40].decode()
-                closest = self.table.closest(target, K_BUCKET_SIZE)
-                out = bytearray()
-                count = 0
-                for enr in closest:
-                    encoded = enr.encode()
-                    if len(out) + len(encoded) > MAX_PACKET - 120:
-                        break
-                    out += encoded
-                    count += 1
-                self._send(addr, _NODES, bytes([count]) + bytes(out))
+                if self._endpoint_proven.get(node_id) != tuple(addr)[:2]:
+                    # unproven source address: a ~49B FINDNODE must not
+                    # reflect a ~1.2KB NODES at a spoofed victim (round-1
+                    # advisor finding). Hold the query, run the proof
+                    # round-trip (our PING -> their PONG), and the PONG
+                    # handler answers it — the querier's single in-flight
+                    # lookup still completes (just one RTT later).
+                    self._pending_findnode[node_id] = (tuple(addr)[:2], target)
+                    self._ping_addr[node_id] = tuple(addr)[:2]
+                    self._send(addr, _PING, self.local_enr.encode())
+                    return
+                self._answer_findnode(tuple(addr)[:2], target)
             elif ptype == _NODES:
                 count = body[0]
                 offset = 1
@@ -340,6 +361,18 @@ class Discovery(asyncio.DatagramProtocol):
                     fut.set_result(enrs)
         except Exception as e:  # malformed packet — drop
             log.debug(f"discovery packet error from {node_id[:8]}: {e}")
+
+    def _answer_findnode(self, addr, target: str) -> None:
+        closest = self.table.closest(target, K_BUCKET_SIZE)
+        out = bytearray()
+        count = 0
+        for enr in closest:
+            encoded = enr.encode()
+            if len(out) + len(encoded) > MAX_PACKET - 120:
+                break
+            out += encoded
+            count += 1
+        self._send(addr, _NODES, bytes([count]) + bytes(out))
 
     def _pubkey_for(self, node_id: str) -> bytes | None:
         """Sender key for packet auth: the learned-keys map, else the
@@ -365,6 +398,7 @@ class Discovery(asyncio.DatagramProtocol):
     async def ping(self, enr: ENR, timeout: float = 2.0) -> bool:
         fut = asyncio.get_running_loop().create_future()
         self._pending_pong[enr.node_id] = fut
+        self._ping_addr[enr.node_id] = (enr.ip, enr.udp_port)  # host+port
         self._send((enr.ip, enr.udp_port), _PING, self.local_enr.encode())
         try:
             await asyncio.wait_for(fut, timeout)

@@ -204,3 +204,29 @@ def test_network_auto_dials_discovered_peers():
     # dial backoff is 5-10 s/retry; under suite load convergence can
     # exceed the shared 60 s run() budget — give this one more headroom
     asyncio.run(asyncio.wait_for(main(), 180.0))
+
+
+def test_findnode_requires_endpoint_proof():
+    """Round-1 advisor low: FINDNODE from an unproven source address gets
+    NO NODES response (anti-reflection) — only a PING to start the proof;
+    after the round trip completes, queries are answered."""
+
+    async def main():
+        ia, ib = _identity(90), _identity(91)
+        da, db = Discovery(ia, _enr(ia)), Discovery(ib, _enr(ib))
+        await da.start()
+        await db.start()
+        try:
+            await da.bootstrap([db.local_enr])  # ping: da proves itself to db
+            assert db._endpoint_proven  # round trip completed
+            db._endpoint_proven.clear()  # simulate an unproven source
+            # the query is HELD through the challenge round-trip and then
+            # answered — one extra RTT, no lost lookup
+            enrs = await da.find_node(db.local_enr, da.local_enr.node_id)
+            assert enrs
+            assert db._endpoint_proven  # proof recorded by the PONG
+        finally:
+            da.stop()
+            db.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
