@@ -128,6 +128,7 @@ class BeaconChain:
             seconds_per_slot=config.SECONDS_PER_SLOT,
             proposer_score_boost=config.PROPOSER_SCORE_BOOST,
             safe_slots_to_update_justified=self.preset.SAFE_SLOTS_TO_UPDATE_JUSTIFIED,
+            justified_balances_getter=self._justified_balances_for,
         )
         self.head_root = anchor_root
 
@@ -417,6 +418,17 @@ class BeaconChain:
             if payload is not None and bytes(payload.block_hash) == block_hash:
                 return root
         return None
+
+    def _justified_balances_for(self, checkpoint):
+        """Effective balances of the checkpoint's OWN state for fork-choice
+        adoption (reference justifiedBalancesGetter, forkChoice.ts:129):
+        resolved from the checkpoint-state cache; None lets fork choice
+        keep its fallback (the importing block's balances)."""
+        epoch, root = checkpoint
+        cached = self.checkpoint_state_cache.get(epoch, root)
+        if cached is None:
+            return None
+        return cached.flat.effective_balance.astype(np.int64)
 
     def _get_pre_state(self, signed_block) -> CachedBeaconState:
         """Pre-state via regen: cache fast path, replay fallback

@@ -35,6 +35,10 @@ class StateRegenerator:
         self._replay_lock = threading.Lock()
         self._pending = 0
         self._pending_lock = threading.Lock()
+        # guards _block_slot_cache: gossip validation and the import path
+        # mutate it from different threads, and the pop(next(iter()))
+        # eviction can KeyError under a race (round-2 advisor)
+        self._slot_cache_lock = threading.Lock()
 
     def _admit(self):
         m = getattr(self.chain, "metrics", None)
@@ -125,16 +129,25 @@ class StateRegenerator:
         follows moments later — the reference's getBlockSlotState role.
         Callers must NOT mutate the returned state (import copies it)."""
         key = (bytes(block.parent_root), int(block.slot))
-        cached = self._block_slot_cache.get(key)
+        with self._slot_cache_lock:
+            cached = self._block_slot_cache.get(key)
         if cached is not None:
             return cached
         pre = self.get_state_for_block(bytes(block.parent_root))
         pre = pre.copy()
         if block.slot > pre.state.slot:
             process_slots(pre, self.chain.types, block.slot)
-        if len(self._block_slot_cache) >= 4:
-            self._block_slot_cache.pop(next(iter(self._block_slot_cache)))
-        self._block_slot_cache[key] = pre
+        # safe to share across reader threads: EpochContext builds its
+        # shufflings/proposer tables eagerly in load_state (cache.py), so
+        # the cached state is immutable for readers — the lock only has to
+        # make the get/evict/insert sequence atomic
+        with self._slot_cache_lock:
+            while len(self._block_slot_cache) >= 4:
+                k = next(iter(self._block_slot_cache), None)
+                if k is None:
+                    break
+                self._block_slot_cache.pop(k, None)
+            self._block_slot_cache[key] = pre
         return pre
 
     def get_checkpoint_state(self, epoch: int, root: bytes):

@@ -54,9 +54,15 @@ class ForkChoice:
         proposer_score_boost: int = 40,
         safe_slots_to_update_justified: int = 8,
         proposer_boost_enabled: bool = True,
+        justified_balances_getter=None,
     ):
         self.store = store
         self.proto = proto_array
+        # resolves (epoch, root) -> effective balances of THAT checkpoint's
+        # state (reference justifiedBalancesGetter, forkChoice.ts:129);
+        # without it adoption falls back to whatever balances the importing
+        # block carried — close, but wrong across a large balance churn
+        self.justified_balances_getter = justified_balances_getter
         self.slots_per_epoch = slots_per_epoch
         self.proto.slots_per_epoch = slots_per_epoch
         self.proto.current_slot = store.current_slot
@@ -112,8 +118,11 @@ class ForkChoice:
             and self._is_descendant_of_finalized(s.best_justified[1])
         ):
             s.justified_checkpoint = s.best_justified
-            if s.best_justified_balances is not None:
-                s.justified_balances = s.best_justified_balances
+            bal = self._resolve_justified_balances(
+                s.best_justified, s.best_justified_balances
+            )
+            if bal is not None:
+                s.justified_balances = bal
             self._justified_proposer_boost_score = None
         if s.unrealized_justified is not None and self._is_descendant_of_finalized(
             s.unrealized_justified[1]
@@ -126,6 +135,15 @@ class ForkChoice:
                 s.unrealized_justified_balances,
                 state_slot=None,  # epoch boundary: adopt unconditionally
             )
+
+    def _resolve_justified_balances(self, checkpoint, fallback):
+        """Balances for the checkpoint's own state when the chain can
+        provide them (checkpoint-state cache), else the caller's fallback."""
+        if self.justified_balances_getter is not None:
+            bal = self.justified_balances_getter(checkpoint)
+            if bal is not None:
+                return bal
+        return fallback
 
     def _is_descendant_of_finalized(self, root: bytes) -> bool:
         fin_epoch, fin_root = self.store.finalized_checkpoint
@@ -237,8 +255,11 @@ class ForkChoice:
             )
             if in_safe_window:
                 s.justified_checkpoint = justified_checkpoint
-                if justified_balances is not None:
-                    s.justified_balances = justified_balances
+                bal = self._resolve_justified_balances(
+                    justified_checkpoint, justified_balances
+                )
+                if bal is not None:
+                    s.justified_balances = bal
                 self._justified_proposer_boost_score = None
         if (
             finalized_checkpoint is not None
@@ -247,8 +268,11 @@ class ForkChoice:
             s.finalized_checkpoint = finalized_checkpoint
             if justified_checkpoint[0] > s.justified_checkpoint[0]:
                 s.justified_checkpoint = justified_checkpoint
-                if justified_balances is not None:
-                    s.justified_balances = justified_balances
+                bal = self._resolve_justified_balances(
+                    justified_checkpoint, justified_balances
+                )
+                if bal is not None:
+                    s.justified_balances = bal
                 self._justified_proposer_boost_score = None
 
     # -- attestations --------------------------------------------------------

@@ -343,8 +343,26 @@ class ProtoArray:
         start = self.indices.get(head_root)
         if start is None:
             return []
+        if latest_valid_root is not None:
+            # a stale/faulty EL can report an LVH that is NOT on the head's
+            # ancestor path; walking until we "hit" it would invalidate the
+            # whole optimistic chain back to the last validated block. Verify
+            # ancestry first — off-path LVH degrades to the no-LVH behavior
+            # (invalidate only the offending payload). (round-2 advisor)
+            idx: int | None = start
+            on_path = False
+            while idx is not None:
+                node = self.nodes[idx]
+                if node.root == latest_valid_root:
+                    on_path = True
+                    break
+                if node.execution_status in ("pre_merge", "valid"):
+                    break
+                idx = node.parent
+            if not on_path:
+                latest_valid_root = None
         bad: set[int] = set()
-        idx: int | None = start
+        idx = start
         while idx is not None:
             node = self.nodes[idx]
             if latest_valid_root is not None and node.root == latest_valid_root:

@@ -90,6 +90,15 @@ typedef struct kv_store {
    * thousands of gets against the same sealed file) */
   int read_fd;
   int read_fd_id;
+  /* rotation threshold: KV_SEG_LIMIT, or LODESTAR_KV_SEG_LIMIT env
+   * override (test hook — lets compaction tests span segments without
+   * writing 256 MB) */
+  uint64_t seg_limit;
+  /* verify record CRCs on get: always during compaction's copy loop
+   * (corruption must not propagate into the new generation) and under
+   * LODESTAR_KV_PARANOID=1; off on the hot read path (open-time replay
+   * already CRC-checks every record) */
+  int verify_reads;
 } kv_store;
 
 static uint64_t kv_hash(const uint8_t *key, size_t len) {
@@ -106,6 +115,7 @@ static const uint8_t *kv_key_at(const kv_store *s, const kv_slot *e) {
 }
 
 static int kv_grow(kv_store *s);
+void lodestar_kv_close(kv_store *s);
 
 /* find slot for key; returns pointer to slot (occupied with the key, or
  * first empty). */
@@ -236,7 +246,7 @@ static int kv_open_active(kv_store *s, uint16_t id, int truncate) {
 }
 
 static int kv_maybe_rotate(kv_store *s) {
-  if (s->active_size < KV_SEG_LIMIT) return 0;
+  if (s->active_size < (s->seg_limit ? s->seg_limit : KV_SEG_LIMIT)) return 0;
   if (s->active_id + 1 >= KV_MAX_SEGS) return 0; /* refuse to wrap */
   fsync(s->active_fd);
   return kv_open_active(s, (uint16_t)(s->active_id + 1), 0);
@@ -309,6 +319,12 @@ kv_store *lodestar_kv_open(const char *dir) {
   s->active_fd = -1;
   s->read_fd = -1;
   s->read_fd_id = -1;
+  {
+    const char *lim = getenv("LODESTAR_KV_SEG_LIMIT");
+    s->seg_limit = lim ? strtoull(lim, NULL, 10) : 0;
+    const char *par = getenv("LODESTAR_KV_PARANOID");
+    s->verify_reads = par && par[0] && par[0] != '0';
+  }
   if (kv_grow(s) != 0) {
     free(s);
     return NULL;
@@ -450,6 +466,31 @@ int64_t lodestar_kv_get(kv_store *s, const uint8_t *key, size_t klen,
   }
   ssize_t got = pread(fd, out, e->val_len, (off_t)e->val_off);
   if (got != (ssize_t)e->val_len) return -2;
+  /* verify the record CRC (header+key live just before the value): a
+   * stale fd or corrupted segment must surface as -2, never as silently
+   * wrong value bytes */
+  if (s->verify_reads) {
+    uint8_t hk[KV_HDR + 256];
+    uint8_t *hkp = hk;
+    size_t hklen = KV_HDR + e->key_len;
+    if (hklen > sizeof(hk)) {
+      hkp = malloc(hklen);
+      if (!hkp) return -2;
+    }
+    off_t rec_off = (off_t)e->val_off - (off_t)hklen;
+    int ok = rec_off >= 0 && pread(fd, hkp, hklen, rec_off) == (ssize_t)hklen;
+    if (ok) {
+      uint32_t crc_stored;
+      memcpy(&crc_stored, hkp, 4);
+      uint32_t want = kv_crc32(0, hkp + 4, KV_HDR - 4);
+      want = kv_crc32(want, hkp + KV_HDR, e->key_len);
+      if (e->val_len) want = kv_crc32(want, out, e->val_len);
+      ok = want == crc_stored &&
+           memcmp(hkp + KV_HDR, key, klen < e->key_len ? klen : e->key_len) == 0;
+    }
+    if (hkp != hk) free(hkp);
+    if (!ok) return -2;
+  }
   return (int64_t)e->val_len;
 }
 
@@ -532,6 +573,8 @@ int lodestar_kv_compact(kv_store *s) {
   uint8_t *vbuf = NULL;
   size_t vcap = 0;
   int rc = 0;
+  int saved_verify = s->verify_reads;
+  s->verify_reads = 1; /* never copy corrupt bytes into the new generation */
   for (uint64_t i = 0; i < s->cap && rc == 0; i++) {
     kv_slot *e = &s->slots[i];
     if (e->key_off == UINT64_MAX || e->val_len == KV_DELETED) continue;
@@ -552,6 +595,7 @@ int lodestar_kv_compact(kv_store *s) {
     rc = lodestar_kv_put(ns, kv_key_at(s, e), e->key_len, vbuf,
                          (size_t)got, 0);
   }
+  s->verify_reads = saved_verify;
   free(vbuf);
   if (rc == 0) rc = lodestar_kv_sync(ns);
   if (rc != 0) {
@@ -590,20 +634,37 @@ int lodestar_kv_compact(kv_store *s) {
       rc = -1;
     }
   }
-  if (rc == 0) {
-    for (int id = 0; id <= (int)s->active_id; id++) {
-      char p[3200];
-      kv_seg_path(s, (uint16_t)id, p, sizeof(p));
-      unlink(p);
-    }
+  if (rc != 0) {
+    /* stage-1/2 failure: the old generation is fully intact on disk and
+     * nothing was promoted — do NOT adopt the new index (adopting here
+     * would point every get at files that don't exist). Clean up the
+     * .new leftovers and keep serving the old state. (round-3 review) */
     for (int id = 0; id <= (int)ns->active_id; id++) {
-      char from[3300], to[3200];
+      char to[3200], from[3300];
       kv_seg_path(s, (uint16_t)id, to, sizeof(to));
       snprintf(from, sizeof(from), "%s.new", to);
-      if (rename(from, to) != 0) rc = -1;
+      unlink(from);
     }
     unlink(marker);
+    lodestar_kv_close(ns);
+    rmdir(tmpdir);
+    return -1;
   }
+  for (int id = 0; id <= (int)s->active_id; id++) {
+    char p[3200];
+    kv_seg_path(s, (uint16_t)id, p, sizeof(p));
+    unlink(p);
+  }
+  for (int id = 0; id <= (int)ns->active_id; id++) {
+    char from[3300], to[3200];
+    kv_seg_path(s, (uint16_t)id, to, sizeof(to));
+    snprintf(from, sizeof(from), "%s.new", to);
+    if (rename(from, to) != 0) rc = -1;
+    /* a promote-stage rename failure is still adopted below: the old
+     * finals are gone and the fsync'd marker lets open-time recovery
+     * finish the promotion */
+  }
+  if (rc == 0) unlink(marker); /* keep the marker while recovery needs it */
   rmdir(tmpdir);
   /* adopt the new store's state in place */
   close(s->active_fd);
@@ -620,8 +681,16 @@ int lodestar_kv_compact(kv_store *s) {
   s->live_bytes = ns->live_bytes;
   s->dead_bytes = 0;
   s->active_fd = -1;
+  /* the sealed-segment fd cache points at a pre-compaction file that was
+   * just unlinked; a post-compaction get whose entry shares the cached
+   * file_id would pread the dead file at new-generation offsets and
+   * return wrong bytes — drop the cache with the old generation */
+  if (s->read_fd >= 0) close(s->read_fd);
+  s->read_fd = -1;
+  s->read_fd_id = -1;
+  uint16_t new_active = ns->active_id;
   free(ns);
-  return kv_open_active(s, 0, 0) || rc;
+  return kv_open_active(s, new_active, 0) || rc;
 }
 
 int lodestar_kv_should_compact(kv_store *s) {

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import struct
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..params import ATTESTATION_SUBNET_COUNT
@@ -33,6 +34,21 @@ ALPHA = 3  # lookup concurrency
 MAX_PACKET = 1280  # discv5 MTU discipline
 PING_INTERVAL = 30.0
 RECORD_TTL = 600.0
+# endpoint-proof challenge bookkeeping bounds (round-2 advisor: identity
+# minting is free, so these maps must not grow with attacker traffic)
+_CHALLENGE_TTL = 5.0
+_MAX_CHALLENGES = 512
+_PROVEN_MAX = 4096
+_KEYS_MAX = 16384
+_CHALLENGE_PINGS_PER_SEC = 64.0  # global budget for challenge PINGs
+_NONCE_WINDOW_SEC = 600.0  # max accepted sender-clock age (anti-replay)
+
+
+def _lru_put(d: "OrderedDict", key, value, cap: int) -> None:
+    d[key] = value
+    d.move_to_end(key)
+    while len(d) > cap:
+        d.popitem(last=False)
 
 _PING = 1
 _PONG = 2
@@ -196,16 +212,28 @@ class Discovery(asyncio.DatagramProtocol):
         self.transport_udp: asyncio.DatagramTransport | None = None
         self._pending_pong: dict[str, asyncio.Future] = {}
         # endpoint proof (anti-reflection): node_id -> addr that answered
-        # OUR ping with a valid PONG (discv5 WHOAREYOU-equivalent role)
-        self._endpoint_proven: dict[str, tuple] = {}
+        # OUR ping with a valid PONG (discv5 WHOAREYOU-equivalent role).
+        # Bounded LRU: fresh signed identities are free to mint, so any
+        # per-identity map an attacker can populate must cap (round-2
+        # advisor) — eviction only costs the evicted peer one extra
+        # challenge round-trip.
+        self._endpoint_proven: "OrderedDict[str, tuple]" = OrderedDict()
+        # live challenges: node_id -> (addr, issued_at monotonic); entries
+        # expire after _CHALLENGE_TTL and the maps cap at _MAX_CHALLENGES
         self._ping_addr: dict[str, tuple] = {}
         # FINDNODEs held back until the challenge round-trip completes:
         # node_id -> (addr, target_id) — answered from the PONG handler
         self._pending_findnode: dict[str, tuple] = {}
         self._pending_nodes: dict[str, asyncio.Future] = {}
-        self._known_keys: dict[str, bytes] = {}  # node_id → pubkey
-        self._last_nonce: dict[str, int] = {}  # node_id → highest seen nonce
+        # node_id → pubkey / highest-seen-nonce: same identity-minting
+        # growth concern as _endpoint_proven, same bounded-LRU treatment
+        self._known_keys: "OrderedDict[str, bytes]" = OrderedDict()
+        self._last_nonce: "OrderedDict[str, int]" = OrderedDict()
         self._nonce = int(time.time() * 1000) << 16  # survives restarts
+        # token bucket for challenge PINGs (each unproven FINDNODE reflects
+        # one ~86B PING; bound the reflected bandwidth toward spoofed addrs)
+        self._challenge_tokens = _CHALLENGE_PINGS_PER_SEC
+        self._challenge_refill_t = time.monotonic()
         self._liveness_task: asyncio.Task | None = None
         self.on_discovered: list = []  # callbacks(enr)
 
@@ -277,6 +305,15 @@ class Discovery(asyncio.DatagramProtocol):
             return
         if nonce <= self._last_nonce.get(node_id, 0):
             return  # replayed or reordered-stale packet
+        # freshness window: the nonce's high 48 bits are the sender's
+        # epoch-ms clock. Bounding _last_nonce (LRU) alone would re-enable
+        # replay of a victim's captured packets once its entry is flooded
+        # out; rejecting packets older than the window closes that hole for
+        # anything but a <window-old capture racing an eviction flood —
+        # consensus peers keep clocks within slot tolerance, so a generous
+        # window costs nothing. (round-3 review)
+        if (nonce >> 16) < (time.time() - _NONCE_WINDOW_SEC) * 1000:
+            return
         asyncio.get_running_loop().create_task(
             self._handle(node_id, sig, nonce, ptype, body, addr, content)
         )
@@ -293,8 +330,8 @@ class Discovery(asyncio.DatagramProtocol):
                     return
                 if not verify_identity(enr.pubkey, sig, b"disc:" + content):
                     return
-                self._last_nonce[node_id] = nonce
-                self._known_keys[node_id] = enr.pubkey
+                _lru_put(self._last_nonce, node_id, nonce, _KEYS_MAX)
+                _lru_put(self._known_keys, node_id, enr.pubkey, _KEYS_MAX)
                 if self.table.update(enr):
                     self._notify(enr)
                 self.table.touch(node_id)
@@ -306,7 +343,7 @@ class Discovery(asyncio.DatagramProtocol):
                 pubkey, sig, b"disc:" + content
             ):
                 return
-            self._last_nonce[node_id] = nonce
+            _lru_put(self._last_nonce, node_id, nonce, _KEYS_MAX)
             self.table.touch(node_id)
 
             if ptype == _PONG:
@@ -318,11 +355,13 @@ class Discovery(asyncio.DatagramProtocol):
                 # demonstrates the peer actually RECEIVES at that address
                 # (a spoofed source cannot complete the round trip).
                 # addr[:2]: IPv6 recvfrom yields 4-tuples; compare host+port.
-                expected = self._ping_addr.get(node_id)
-                if expected is not None and tuple(addr)[:2] == tuple(expected)[:2]:
+                entry = self._ping_addr.get(node_id)
+                if entry is not None and tuple(addr)[:2] == tuple(entry[0])[:2]:
                     del self._ping_addr[node_id]  # pop ONLY on match: a
                     # concurrent ping must not destroy a live challenge
-                    self._endpoint_proven[node_id] = tuple(addr)[:2]
+                    _lru_put(
+                        self._endpoint_proven, node_id, tuple(addr)[:2], _PROVEN_MAX
+                    )
                     held = self._pending_findnode.pop(node_id, None)
                     if held is not None:
                         self._answer_findnode(held[0], held[1])
@@ -331,18 +370,41 @@ class Discovery(asyncio.DatagramProtocol):
                     fut.set_result(True)
             elif ptype == _FINDNODE:
                 target = body[:40].decode()
-                if self._endpoint_proven.get(node_id) != tuple(addr)[:2]:
-                    # unproven source address: a ~49B FINDNODE must not
-                    # reflect a ~1.2KB NODES at a spoofed victim (round-1
-                    # advisor finding). Hold the query, run the proof
-                    # round-trip (our PING -> their PONG), and the PONG
-                    # handler answers it — the querier's single in-flight
-                    # lookup still completes (just one RTT later).
-                    self._pending_findnode[node_id] = (tuple(addr)[:2], target)
-                    self._ping_addr[node_id] = tuple(addr)[:2]
-                    self._send(addr, _PING, self.local_enr.encode())
+                proven = self._endpoint_proven.get(node_id)
+                if proven == tuple(addr)[:2]:
+                    self._endpoint_proven.move_to_end(node_id)  # keep hot
+                    self._answer_findnode(tuple(addr)[:2], target)
                     return
-                self._answer_findnode(tuple(addr)[:2], target)
+                # unproven source address: a ~49B FINDNODE must not
+                # reflect a ~1.2KB NODES at a spoofed victim (round-1
+                # advisor finding). Hold the query, run the proof
+                # round-trip (our PING -> their PONG), and the PONG
+                # handler answers it — the querier's single in-flight
+                # lookup still completes (just one RTT later).
+                now = time.monotonic()
+                self._gc_challenges(now)
+                live = self._ping_addr.get(node_id)
+                if live is not None:
+                    # challenge already in flight for this identity: refresh
+                    # the held query, never issue a second PING (per-identity
+                    # amplification would defeat the rate limit)
+                    if tuple(addr)[:2] == tuple(live[0])[:2]:
+                        self._pending_findnode[node_id] = (tuple(addr)[:2], target)
+                    return
+                if len(self._ping_addr) >= _MAX_CHALLENGES:
+                    return  # full table of live challenges: shed load
+                self._challenge_tokens = min(
+                    _CHALLENGE_PINGS_PER_SEC,
+                    self._challenge_tokens
+                    + (now - self._challenge_refill_t) * _CHALLENGE_PINGS_PER_SEC,
+                )
+                self._challenge_refill_t = now
+                if self._challenge_tokens < 1.0:
+                    return  # over the global challenge-PING budget
+                self._challenge_tokens -= 1.0
+                self._pending_findnode[node_id] = (tuple(addr)[:2], target)
+                self._ping_addr[node_id] = (tuple(addr)[:2], now)
+                self._send(addr, _PING, self.local_enr.encode())
             elif ptype == _NODES:
                 count = body[0]
                 offset = 1
@@ -353,7 +415,7 @@ class Discovery(asyncio.DatagramProtocol):
                         enrs.append(enr)
                         # record the key: packets from relayed peers must be
                         # verifiable, or multi-hop discovery can't converge
-                        self._known_keys[enr.node_id] = enr.pubkey
+                        _lru_put(self._known_keys, enr.node_id, enr.pubkey, _KEYS_MAX)
                         if self.table.update(enr):
                             self._notify(enr)
                 fut = self._pending_nodes.pop(node_id, None)
@@ -361,6 +423,18 @@ class Discovery(asyncio.DatagramProtocol):
                     fut.set_result(enrs)
         except Exception as e:  # malformed packet — drop
             log.debug(f"discovery packet error from {node_id[:8]}: {e}")
+
+    def _gc_challenges(self, now: float) -> None:
+        """Expire stale challenge state; held FINDNODEs die with their
+        challenge (the querier simply retries)."""
+        expired = [
+            nid
+            for nid, (_, t) in self._ping_addr.items()
+            if now - t > _CHALLENGE_TTL
+        ]
+        for nid in expired:
+            self._ping_addr.pop(nid, None)
+            self._pending_findnode.pop(nid, None)
 
     def _answer_findnode(self, addr, target: str) -> None:
         closest = self.table.closest(target, K_BUCKET_SIZE)
@@ -382,7 +456,7 @@ class Discovery(asyncio.DatagramProtocol):
             return pubkey
         for enr in self.table.all():
             if enr.node_id == node_id:
-                self._known_keys[node_id] = enr.pubkey
+                _lru_put(self._known_keys, node_id, enr.pubkey, _KEYS_MAX)
                 return enr.pubkey
         return None
 
@@ -398,7 +472,7 @@ class Discovery(asyncio.DatagramProtocol):
     async def ping(self, enr: ENR, timeout: float = 2.0) -> bool:
         fut = asyncio.get_running_loop().create_future()
         self._pending_pong[enr.node_id] = fut
-        self._ping_addr[enr.node_id] = (enr.ip, enr.udp_port)  # host+port
+        self._ping_addr[enr.node_id] = ((enr.ip, enr.udp_port), time.monotonic())
         self._send((enr.ip, enr.udp_port), _PING, self.local_enr.encode())
         try:
             await asyncio.wait_for(fut, timeout)
@@ -427,7 +501,7 @@ class Discovery(asyncio.DatagramProtocol):
         for enr in bootnodes:
             if not enr.verify() or enr.node_id == self.local_enr.node_id:
                 continue
-            self._known_keys[enr.node_id] = enr.pubkey
+            _lru_put(self._known_keys, enr.node_id, enr.pubkey, _KEYS_MAX)
             if self.table.update(enr):
                 self._notify(enr)
             await self.ping(enr)
