@@ -17,12 +17,27 @@ from .routes import match_route
 
 
 class BeaconApiServer:
-    def __init__(self, impl, host: str = "127.0.0.1", port: int = 0, matcher=None):
+    def __init__(
+        self, impl, host: str = "127.0.0.1", port: int = 0, matcher=None,
+        metrics=None,
+    ):
         """`matcher(method, path) -> (route, params)`: defaults to the
         beacon route table; the keymanager server passes its own."""
         self.impl = impl
         impl_ref = impl
         match = matcher if matcher is not None else match_route
+        metrics_ref = metrics
+
+        def _observe(path: str, status: int, seconds: float) -> None:
+            if metrics_ref is None:
+                return
+            # bounded cardinality: the namespace segment, not the full path
+            parts = path.split("/")
+            ns = parts[2] if len(parts) > 2 else "root"
+            metrics_ref.api_requests_total.inc(
+                namespace=ns, status=f"{status // 100}xx"
+            )
+            metrics_ref.api_request_seconds.observe(seconds, namespace=ns)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
@@ -87,6 +102,8 @@ class BeaconApiServer:
 
                 for e in ChainEvent:
                     emitter.on(e, on_event)
+                if metrics_ref is not None:
+                    metrics_ref.api_sse_subscribers.inc(1)
                 try:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
@@ -105,10 +122,18 @@ class BeaconApiServer:
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass  # client went away
                 finally:
+                    if metrics_ref is not None:
+                        metrics_ref.api_sse_subscribers.inc(-1)
                     for e in ChainEvent:
                         emitter.off(e, on_event)
 
             def _send(self, status: int, obj):
+                import time as _t
+
+                _observe(
+                    urlparse(self.path).path, status,
+                    _t.monotonic() - getattr(self, "_t0", _t.monotonic()),
+                )
                 payload = json.dumps(obj).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -117,12 +142,21 @@ class BeaconApiServer:
                 self.wfile.write(payload)
 
             def do_GET(self):
+                import time as _t
+
+                self._t0 = _t.monotonic()
                 self._handle("GET")
 
             def do_POST(self):
+                import time as _t
+
+                self._t0 = _t.monotonic()
                 self._handle("POST")
 
             def do_DELETE(self):
+                import time as _t
+
+                self._t0 = _t.monotonic()
                 self._handle("DELETE")
 
         self._server = ThreadingHTTPServer((host, port), Handler)

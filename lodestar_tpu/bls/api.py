@@ -29,6 +29,7 @@ from .curve import (
     g2_from_bytes,
     g2_to_bytes,
 )
+from .fields import Fq
 from .fields import R as CURVE_ORDER
 from .hash_to_curve import DST_G2, hash_to_g2
 from .pairing import multi_pairing
@@ -159,9 +160,38 @@ class Signature:
 
 def aggregate_pubkeys(pubkeys: list[PublicKey]) -> PublicKey:
     """G1 sum (reference: getAggregatedPubkey on the main thread,
-    chain/bls/utils.ts:5 — jacobian aggregation)."""
+    chain/bls/utils.ts:5 — jacobian aggregation).
+
+    Hot path (every attestation/sync aggregate sums up to 512 pubkeys):
+    the native C tier sums compressed keys in one GIL-released call
+    (`native/src/bls12.c lodestar_bls_g1_aggregate`); subgroup checks are
+    skipped there because PublicKey construction KeyValidates. Falls back
+    to big-int addition when the extension is unavailable."""
     if not pubkeys:
         raise BlsError("cannot aggregate empty pubkey list")
+    if len(pubkeys) > 1:
+        from .. import native as _native
+
+        if _native.HAVE_NATIVE_BLS:
+            try:
+                pk_b = b"".join(pk.to_bytes() for pk in pubkeys)
+            except (BlsError, ValueError):
+                pk_b = None
+            if pk_b is not None:
+                rc, limbs = _native.bls_g1_aggregate(pk_b, check_each=False)
+                if rc == 1:
+                    return PublicKey(PointG1.zero())
+                if rc == 0:
+                    from ..ops.limbs import fp_from_mont_host
+
+                    return PublicKey(
+                        PointG1(
+                            Fq(fp_from_mont_host(limbs[0])),
+                            Fq(fp_from_mont_host(limbs[1])),
+                            Fq(1),
+                        )
+                    )
+                # rc < 0: malformed bytes — report through the slow path
     acc = PointG1.zero()
     for pk in pubkeys:
         acc = acc + pk.point

@@ -44,6 +44,9 @@ class Archiver:
             if root in canonical_set:
                 self.db.archive_block(signed)
                 self.chain.finalized_blocks[root] = signed
+                m = getattr(self.chain, "metrics", None)
+                if m is not None:
+                    m.archiver_blocks_total.inc()
             del self.chain.blocks[root]
             if self.db.block.has(root):
                 self.db.block.delete(root)
@@ -55,5 +58,8 @@ class Archiver:
                 self.db.state_archive.put(
                     self.db.state_archive.slot_key(state.state.slot), state.state
                 )
+                m = getattr(self.chain, "metrics", None)
+                if m is not None:
+                    m.archiver_states_total.inc()
         self.last_archived_epoch = fin_epoch
         self.chain.fork_choice.prune()

@@ -684,7 +684,10 @@ class BeaconChain:
 
     def on_gossip_attestation(self, attestation, data_root: bytes) -> None:
         with self.import_lock:
-            self.attestation_pool.add(attestation, data_root)
+            outcome = self.attestation_pool.add(attestation, data_root)
+        m = getattr(self, "metrics", None)
+        if m is not None:
+            m.attestation_pool_inserts_total.inc(outcome=str(outcome))
         monitor = getattr(self, "validator_monitor", None)
         if monitor is not None and monitor.monitored:
             try:

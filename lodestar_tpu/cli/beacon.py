@@ -355,7 +355,8 @@ async def _maybe_range_sync(node, network, clock_slot: int, loop, log) -> None:
 
     def run_sync() -> int:
         rs = RangeSync(
-            node.chain, node.types, node.config.preset.SLOTS_PER_EPOCH
+            node.chain, node.types, node.config.preset.SLOTS_PER_EPOCH,
+            metrics=getattr(node, "metrics", None),
         )
         for peer in peers:
             rs.add_peer(peer)

@@ -69,6 +69,7 @@ class FileDb(MemoryDb):
     COMPACT_WASTE_RATIO = 4
 
     def __init__(self, path: str):
+        self.metrics = None  # set by the node for compaction counters
         super().__init__()
         self.path = path
         self._ops = 0
@@ -121,6 +122,9 @@ class FileDb(MemoryDb):
     def _maybe_compact(self) -> None:
         if self._ops > self.COMPACT_WASTE_RATIO * max(64, len(self._data)):
             self.compact()
+            m = getattr(self, "metrics", None)
+            if m is not None:
+                m.db_compactions_total.inc()
 
     def compact(self) -> None:
         tmp = self.path + ".compact"

@@ -89,7 +89,9 @@ class Eth1ProviderHttp:
         retry_delay: float = 0.5,
         timeout: float = 12.0,
         follow_distance: int | None = None,
+        metrics=None,
     ):
+        self.metrics = metrics
         self.config = config
         self.types = types
         self.host = host
@@ -132,10 +134,18 @@ class Eth1ProviderHttp:
     def _call(self, method: str, params: list):
         last: Exception | None = None
         for attempt in range(self.retries):
+            t0 = time.monotonic()
             try:
-                return self._call_once(method, params)
+                out = self._call_once(method, params)
+                if self.metrics is not None:
+                    self.metrics.eth1_request_seconds.observe(
+                        time.monotonic() - t0, method=method
+                    )
+                return out
             except (OSError, RuntimeError, ValueError) as e:
                 last = e
+                if self.metrics is not None:
+                    self.metrics.eth1_request_errors_total.inc()
                 time.sleep(self.retry_delay * (2**attempt))
         raise RuntimeError(f"eth1 rpc {method} failed after retries: {last}")
 
@@ -143,6 +153,9 @@ class Eth1ProviderHttp:
 
     def latest_block_number(self) -> int:
         head = _num(self._call("eth_blockNumber", []))
+        if self.metrics is not None:
+            self.metrics.eth1_follow_distance.set(self.follow_distance)
+            self.metrics.eth1_synced_block.set(max(self.deploy_block, head - self.follow_distance))
         return max(self.deploy_block, head - self.follow_distance)
 
     def get_deposit_logs(self, from_block: int, to_block: int) -> list[DepositLog]:
@@ -170,6 +183,9 @@ class Eth1ProviderHttp:
                     raise
                 chunk = max(1, chunk // 2)  # halve and retry the range
                 continue
+            if self.metrics is not None:
+                self.metrics.eth1_logs_batch_size.observe(len(logs))
+                self.metrics.eth1_deposits_total.inc(len(logs))
             out.extend(parse_deposit_log(self.types, lg) for lg in logs)
             frm = to + 1
         out.sort(key=lambda l: l.index)

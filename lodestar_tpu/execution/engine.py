@@ -214,16 +214,22 @@ class ExecutionEngineHttp:
     """JSON-RPC engine client (engine_newPayloadV1 / forkchoiceUpdatedV1 /
     getPayloadV1) with fresh JWT per request (reference http.ts)."""
 
-    def __init__(self, host: str, port: int, jwt_secret: bytes, timeout: float = 8.0):
+    def __init__(
+        self, host: str, port: int, jwt_secret: bytes, timeout: float = 8.0,
+        metrics=None,
+    ):
         self.host = host
         self.port = port
         self.jwt_secret = jwt_secret
         self.timeout = timeout
+        self.metrics = metrics
         self._id = 0
 
     def _call(self, method: str, params: list):
         import http.client
+        import time as _time
 
+        t0 = _time.monotonic()
         self._id += 1
         body = json.dumps(
             {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
@@ -242,6 +248,14 @@ class ExecutionEngineHttp:
             resp = json.loads(conn.getresponse().read())
         finally:
             conn.close()
+        if self.metrics is not None:
+            self.metrics.engine_request_seconds.observe(
+                _time.monotonic() - t0, method=method
+            )
+            self.metrics.engine_requests_total.inc(
+                method=method,
+                outcome="error" if "error" in resp else "ok",
+            )
         if "error" in resp:
             raise RuntimeError(f"{method}: {resp['error']}")
         return resp["result"]
@@ -257,6 +271,10 @@ class ExecutionEngineHttp:
         )
         version = "V2" if "withdrawals" in payload_json else "V1"
         result = self._call(f"engine_newPayload{version}", [payload_json])
+        if self.metrics is not None:
+            self.metrics.engine_payload_status_total.inc(
+                status=str(result.get("status"))
+            )
         lvh_hex = result.get("latestValidHash")
         lvh = (
             bytes.fromhex(lvh_hex.removeprefix("0x"))

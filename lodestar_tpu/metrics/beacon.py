@@ -203,4 +203,235 @@ def create_beacon_metrics(registry: MetricsRegistry | None = None):
     m.fork_choice_votes = r.gauge(
         "lodestar_fork_choice_tracked_votes", "validators with live LMD votes"
     )
+
+    # --- gossipsub detail (reference lodestar.ts gossipsub.* — per-topic
+    # accept/reject/ignore, control traffic, mesh churn, score buckets) ---
+    m.gossip_validation_total = r.counter(
+        "lodestar_gossip_validation_total",
+        "validation results per topic kind",
+        label_names=("kind", "outcome"),
+    )
+    m.gossip_duplicates_total = r.counter(
+        "lodestar_gossip_duplicate_messages_total",
+        "messages already seen (dropped pre-validation)",
+    )
+    m.gossip_graft_rx_total = r.counter(
+        "lodestar_gossip_graft_received_total", "GRAFT control messages received"
+    )
+    m.gossip_prune_rx_total = r.counter(
+        "lodestar_gossip_prune_received_total", "PRUNE control messages received"
+    )
+    m.gossip_ihave_rx_total = r.counter(
+        "lodestar_gossip_ihave_received_total", "IHAVE ids advertised to us"
+    )
+    m.gossip_iwant_rx_total = r.counter(
+        "lodestar_gossip_iwant_received_total", "IWANT ids requested from us"
+    )
+    m.gossip_iwant_served_total = r.counter(
+        "lodestar_gossip_iwant_served_total", "IWANT ids answered from mcache"
+    )
+    m.gossip_iwant_budget_drops_total = r.counter(
+        "lodestar_gossip_iwant_budget_drops_total",
+        "IWANT ids dropped by the per-peer budget/score gate",
+    )
+    m.gossip_peers_by_score = r.gauge(
+        "lodestar_gossip_peers_by_score",
+        "peer count per score band",
+        label_names=("band",),
+    )
+    m.gossip_score_min = r.gauge(
+        "lodestar_gossip_peer_score_min", "lowest peer score"
+    )
+    m.gossip_score_max = r.gauge(
+        "lodestar_gossip_peer_score_max", "highest peer score"
+    )
+    m.gossip_mesh_churn_total = r.counter(
+        "lodestar_gossip_mesh_churn_total",
+        "mesh membership changes",
+        label_names=("direction",),
+    )
+    m.gossip_validation_seconds = r.histogram(
+        "lodestar_gossip_validation_seconds",
+        "validator latency per topic kind",
+        label_names=("kind",),
+    )
+
+    # --- reqresp detail (reference lodestar.ts reqResp.* — per-protocol
+    # request/byte/error counters, rate limits) ---------------------------
+    m.reqresp_incoming_requests_total = r.counter(
+        "lodestar_reqresp_incoming_requests_total",
+        "inbound requests per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_incoming_errors_total = r.counter(
+        "lodestar_reqresp_incoming_errors_total",
+        "inbound requests that errored per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_outgoing_requests_total = r.counter(
+        "lodestar_reqresp_outgoing_requests_total",
+        "outbound requests per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_outgoing_errors_total = r.counter(
+        "lodestar_reqresp_outgoing_errors_total",
+        "outbound requests that errored per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_bytes_sent_total = r.counter(
+        "lodestar_reqresp_bytes_sent_total",
+        "response bytes written per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_bytes_received_total = r.counter(
+        "lodestar_reqresp_bytes_received_total",
+        "response bytes read per protocol",
+        label_names=("protocol",),
+    )
+    m.reqresp_rate_limited_total = r.counter(
+        "lodestar_reqresp_rate_limited_total",
+        "requests refused by rate limiters",
+        label_names=("limiter",),
+    )
+    m.reqresp_response_chunks_total = r.counter(
+        "lodestar_reqresp_response_chunks_total",
+        "response chunks received per result code",
+        label_names=("code",),
+    )
+
+    # --- sync detail (reference lodestar.ts sync.* — batch states,
+    # processed-block rate, peer counts per sync kind) --------------------
+    m.sync_batches_in_state = r.gauge(
+        "lodestar_sync_batches_in_state",
+        "range-sync batches per state",
+        label_names=("state",),
+    )
+    m.sync_blocks_imported_total = r.counter(
+        "lodestar_sync_blocks_imported_total", "blocks imported by range sync"
+    )
+    m.sync_segment_seconds = r.histogram(
+        "lodestar_sync_segment_import_seconds", "segment import latency"
+    )
+    m.sync_peers = r.gauge(
+        "lodestar_sync_peers", "peers usable per sync kind",
+        label_names=("kind",),
+    )
+    m.sync_status = r.gauge(
+        "lodestar_sync_status", "0 stalled / 1 syncing / 2 synced"
+    )
+    m.backfill_batches_total = r.counter(
+        "lodestar_backfill_batches_total", "backfill batches by outcome",
+        label_names=("outcome",),
+    )
+
+    # --- eth1 detail (reference lodestar.ts eth1.*) ----------------------
+    m.eth1_follow_distance = r.gauge(
+        "lodestar_eth1_follow_distance_blocks",
+        "blocks between eth1 head and our synced block",
+    )
+    m.eth1_request_seconds = r.histogram(
+        "lodestar_eth1_request_seconds", "eth1 JSON-RPC latency",
+        label_names=("method",),
+    )
+    m.eth1_logs_batch_size = r.histogram(
+        "lodestar_eth1_logs_batch_size", "deposit logs per getLogs window"
+    )
+
+    # --- execution engine (reference lodestar.ts executionEngine.*) ------
+    m.engine_requests_total = r.counter(
+        "lodestar_engine_http_requests_total",
+        "engine API calls by method and outcome",
+        label_names=("method", "outcome"),
+    )
+    m.engine_request_seconds = r.histogram(
+        "lodestar_engine_http_seconds", "engine API latency",
+        label_names=("method",),
+    )
+    m.engine_payload_status_total = r.counter(
+        "lodestar_engine_payload_status_total",
+        "newPayload verdicts",
+        label_names=("status",),
+    )
+
+    # --- REST API server (reference lodestar.ts restApi.*) ---------------
+    m.api_requests_total = r.counter(
+        "lodestar_api_requests_total",
+        "REST requests by namespace and status class",
+        label_names=("namespace", "status"),
+    )
+    m.api_request_seconds = r.histogram(
+        "lodestar_api_request_seconds", "REST handler latency",
+        label_names=("namespace",),
+    )
+    m.api_sse_subscribers = r.gauge(
+        "lodestar_api_sse_subscribers", "open event-stream connections"
+    )
+
+    # --- chain internals (epoch transitions, caches, archiver) -----------
+    m.epoch_transition_seconds = r.histogram(
+        "lodestar_stfn_epoch_transition_seconds", "epoch processing latency"
+    )
+    m.state_hash_seconds = r.histogram(
+        "lodestar_stfn_hash_tree_root_seconds",
+        "incremental state hashing latency",
+    )
+    m.state_hash_dirty_validators = r.histogram(
+        "lodestar_stfn_hash_dirty_validators",
+        "validator rows re-hashed per state root",
+    )
+    m.shuffling_cache_hits_total = r.counter(
+        "lodestar_shuffling_cache_hits_total", "epoch shuffling cache hits"
+    )
+    m.shuffling_cache_misses_total = r.counter(
+        "lodestar_shuffling_cache_misses_total", "epoch shuffling cache builds"
+    )
+    m.attestation_pool_inserts_total = r.counter(
+        "lodestar_attestation_pool_inserts_total",
+        "attestation pool insert outcomes",
+        label_names=("outcome",),
+    )
+    m.archiver_states_total = r.counter(
+        "lodestar_archiver_states_written_total", "states archived"
+    )
+    m.archiver_blocks_total = r.counter(
+        "lodestar_archiver_blocks_migrated_total",
+        "finalized blocks migrated to cold storage",
+    )
+    m.seen_cache_size = r.gauge(
+        "lodestar_seen_cache_size", "entries per seen-cache kind",
+        label_names=("kind",),
+    )
+
+    # --- validator client (reference lodestar.ts validator.*) ------------
+    m.vc_duties_total = r.counter(
+        "lodestar_vc_duties_total", "duties performed by kind and outcome",
+        label_names=("kind", "outcome"),
+    )
+    m.vc_signer_seconds = r.histogram(
+        "lodestar_vc_signer_seconds", "signing latency",
+        label_names=("kind",),
+    )
+
+    # --- process health (reference nodejs.* equivalents) -----------------
+    m.event_loop_lag_seconds = r.gauge(
+        "lodestar_event_loop_lag_seconds", "asyncio scheduling lag"
+    )
+    m.process_rss_bytes = r.gauge(
+        "lodestar_process_rss_bytes", "resident set size"
+    )
+    m.open_fds = r.gauge("lodestar_process_open_fds", "open file descriptors")
+    m.clock_epoch = r.gauge("beacon_clock_epoch", "wall-clock epoch")
+    m.active_validators = r.gauge(
+        "beacon_current_active_validators", "active validator count"
+    )
+    m.head_distance = r.gauge(
+        "lodestar_head_slot_distance",
+        "slots between wall clock and head (sync lag)",
+    )
+    m.db_compactions_total = r.counter(
+        "lodestar_db_compactions_total", "KV log compactions run"
+    )
+    m.h2c_cache_size = r.gauge(
+        "lodestar_bls_verifier_h2c_cache_size", "hash-to-curve cache entries"
+    )
     return m

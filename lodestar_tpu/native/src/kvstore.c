@@ -564,10 +564,29 @@ void lodestar_kv_stats(kv_store *s, uint64_t out[4]) {
   out[3] = (uint64_t)s->active_id;
 }
 
+/* unlink every regular file in dir (best-effort; missing dir is fine). */
+static void kv_purge_dir(const char *dir) {
+  DIR *d = opendir(dir);
+  if (!d) return;
+  struct dirent *de;
+  char p[3400];
+  while ((de = readdir(d)) != NULL) {
+    if (de->d_name[0] == '.') continue;
+    snprintf(p, sizeof(p), "%s/%s", dir, de->d_name);
+    unlink(p);
+  }
+  closedir(d);
+}
+
 /* compaction: rewrite live records into a fresh segment line. */
 int lodestar_kv_compact(kv_store *s) {
   char tmpdir[3200];
   snprintf(tmpdir, sizeof(tmpdir), "%s/compact.tmp", s->dir);
+  /* purge leftovers from any previously-failed compaction BEFORE opening:
+   * stale segments in compact.tmp would be replayed by lodestar_kv_open
+   * into the new generation and could resurrect keys deleted since the
+   * failed run (round-3 review). */
+  kv_purge_dir(tmpdir);
   kv_store *ns = lodestar_kv_open(tmpdir);
   if (!ns) return -1;
   uint8_t *vbuf = NULL;
@@ -599,9 +618,12 @@ int lodestar_kv_compact(kv_store *s) {
   free(vbuf);
   if (rc == 0) rc = lodestar_kv_sync(ns);
   if (rc != 0) {
-    /* abandon: remove tmp segments */
+    /* abandon: close AND purge the tmp segments so they cannot be
+     * replayed into a later compaction's new generation */
     void lodestar_kv_close(kv_store *);
     lodestar_kv_close(ns);
+    kv_purge_dir(tmpdir);
+    rmdir(tmpdir);
     return -1;
   }
   /* crash-safe swap (round-2 review: unlink-all-then-rename loses the
@@ -647,6 +669,7 @@ int lodestar_kv_compact(kv_store *s) {
     }
     unlink(marker);
     lodestar_kv_close(ns);
+    kv_purge_dir(tmpdir); /* segments the rename loop never reached */
     rmdir(tmpdir);
     return -1;
   }

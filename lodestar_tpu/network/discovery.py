@@ -288,7 +288,11 @@ class Discovery(asyncio.DatagramProtocol):
         # monotonic per-sender nonce, covered by the signature: receivers
         # reject non-increasing nonces, so captured packets can't be
         # replayed to fake liveness or reflect NODES at victims
-        self._nonce += 1
+        # advance the clock component on every send (not just at startup):
+        # receivers enforce a freshness window on the high 48 bits, so a
+        # nonce pinned at process-start time would make every packet from
+        # a >window-old process look stale and break discovery liveness
+        self._nonce = max(self._nonce + 1, int(time.time() * 1000) << 16)
         content = struct.pack(">Q", self._nonce) + bytes([ptype]) + body
         sig = self.identity.sign(b"disc:" + content)
         packet = self.local_enr.node_id.encode() + sig + content

@@ -55,6 +55,17 @@ MAX_IHAVE_PER_HEARTBEAT = 5000
 # per-peer IWANT service budget, reset each heartbeat (bandwidth-sink guard)
 MAX_IWANT_SERVED_PER_HEARTBEAT = 512
 
+
+def _topic_kind(topic: str) -> str:
+    """Topic kind for metric labels (bounded cardinality: subnet topics
+    collapse onto their kind)."""
+    from .topic import parse_topic
+
+    try:
+        return parse_topic(topic).type.value
+    except ValueError:
+        return "unknown"
+
 log = get_logger("gossipsub")
 
 
@@ -265,13 +276,25 @@ class Gossipsub:
         first = self.seen.put(msg_id)
         self.score.deliver_message(peer_id, topic, first=first)
         if not first:
+            if self.metrics is not None:
+                self.metrics.gossip_duplicates_total.inc()
             return
         if topic not in self.subscriptions:
             # not our topic: don't validate or forward
             return
+        import time as _time
+
+        t0 = _time.monotonic()
         result = await self._validate(topic, data)
         if self.metrics is not None:
             self.metrics.gossip_rx_total.inc(outcome=result.value)
+            kind = _topic_kind(topic)
+            self.metrics.gossip_validation_total.inc(
+                kind=kind, outcome=result.value
+            )
+            self.metrics.gossip_validation_seconds.observe(
+                _time.monotonic() - t0, kind=kind
+            )
         if result is ValidationResult.REJECT:
             self.score.reject_message(peer_id, topic)
             return
@@ -305,6 +328,11 @@ class Gossipsub:
             await self._send(pid, rpc)
 
     async def _handle_graft_prune(self, peer: PeerState, rpc: RPC) -> None:
+        if self.metrics is not None:
+            if rpc.graft:
+                self.metrics.gossip_graft_rx_total.inc(len(rpc.graft))
+            if rpc.prune:
+                self.metrics.gossip_prune_rx_total.inc(len(rpc.prune))
         prunes = []
         now = self._time()
         for topic in rpc.graft:
@@ -321,17 +349,28 @@ class Gossipsub:
             else:
                 mesh.add(peer.peer_id)
                 self.score.graft(peer.peer_id, topic)
+                if self.metrics is not None:
+                    self.metrics.gossip_mesh_churn_total.inc(direction="graft")
         for pr in rpc.prune:
             mesh = self.mesh.get(pr.topic)
             if mesh is not None and peer.peer_id in mesh:
                 mesh.discard(peer.peer_id)
                 self.score.prune(peer.peer_id, pr.topic)
+                if self.metrics is not None:
+                    self.metrics.gossip_mesh_churn_total.inc(direction="prune")
             peer.dont_send_until[pr.topic] = now + pr.backoff_sec
         if prunes:
             await self._send(peer.peer_id, RPC(prune=prunes))
 
     async def _handle_gossip_control(self, peer: PeerState, rpc: RPC) -> None:
         peer_score = self.score.score(peer.peer_id)  # once per RPC
+        if self.metrics is not None:
+            if rpc.ihave:
+                self.metrics.gossip_ihave_rx_total.inc(
+                    sum(len(ih.msg_ids) for ih in rpc.ihave)
+                )
+            if rpc.iwant:
+                self.metrics.gossip_iwant_rx_total.inc(len(rpc.iwant))
         # IHAVE → request unseen ids (only from peers above gossip threshold)
         if rpc.ihave and peer_score >= GOSSIP_THRESHOLD:
             want = []
@@ -352,17 +391,31 @@ class Gossipsub:
             )
             if budget > 0:
                 msgs = []
+                examined = 0
                 for mid in rpc.iwant:
                     if len(msgs) >= budget:
                         break  # budget counts SERVED messages, not ids
+                    examined += 1
                     entry = self.mcache.get(mid)
                     if entry is not None:
                         msgs.append(entry)
+                if self.metrics is not None:
+                    # only ids the serve loop never reached were gated by
+                    # the budget; examined-but-expired ids are not drops
+                    skipped = len(rpc.iwant) - examined
+                    if skipped > 0:
+                        self.metrics.gossip_iwant_budget_drops_total.inc(skipped)
                 if msgs:
                     self._iwant_served[peer.peer_id] = (
                         self._iwant_served.get(peer.peer_id, 0) + len(msgs)
                     )
+                    if self.metrics is not None:
+                        self.metrics.gossip_iwant_served_total.inc(len(msgs))
                     await self._send(peer.peer_id, RPC(messages=msgs))
+            elif self.metrics is not None:
+                # budget exhausted before this RPC: everything requested
+                # was gated by the budget
+                self.metrics.gossip_iwant_budget_drops_total.inc(len(rpc.iwant))
 
     # -------------------------------------------------------------- heartbeat
 

@@ -358,6 +358,38 @@ class Network:
         self._mesh_kinds_seen |= set(by_kind)
         for kind, size in by_kind.items():
             m.gossip_mesh_peers.set(size, kind=kind)
+        # peer-score distribution (reference gossipsub scores dashboard)
+        scores = [
+            self.gossip.score.score(pid) for pid in self.gossip.peers
+        ]
+        if scores:
+            bands = {"negative": 0, "zero": 0, "positive": 0}
+            for sc in scores:
+                if sc < 0:
+                    bands["negative"] += 1
+                elif sc > 0:
+                    bands["positive"] += 1
+                else:
+                    bands["zero"] += 1
+            for band, n in bands.items():
+                m.gossip_peers_by_score.set(n, band=band)
+            m.gossip_score_min.set(min(scores))
+            m.gossip_score_max.set(max(scores))
+        # process health
+        try:
+            import os as _os
+
+            with open("/proc/self/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            m.process_rss_bytes.set(rss_pages * _os.sysconf("SC_PAGE_SIZE"))
+        except Exception:
+            pass
+        try:
+            import os as _os
+
+            m.open_fds.set(len(_os.listdir("/proc/self/fd")))
+        except Exception:
+            pass
         for gtype, queue in self.gossip_handlers.queues.items():
             m.gossip_queue_length.set(len(queue), topic=gtype.value)
             seen = self._queue_drops_seen.get(gtype.value, 0)
@@ -368,7 +400,12 @@ class Network:
 
     async def _heartbeat_loop(self) -> None:
         while True:
+            t0 = asyncio.get_running_loop().time()
             await asyncio.sleep(HEARTBEAT_SEC)
+            if self.metrics is not None:
+                # scheduling overshoot of the sleep = event-loop lag
+                lag = asyncio.get_running_loop().time() - t0 - HEARTBEAT_SEC
+                self.metrics.event_loop_lag_seconds.set(max(0.0, lag))
             try:
                 self._export_metrics()
                 await self._refresh_subnet_subscriptions()
@@ -421,10 +458,36 @@ class Network:
 
 
 class _ReqRespMetricsAdapter:
-    """Bridges ReqRespService's observe hook onto the metric registry."""
+    """Bridges ReqRespService's observe hooks onto the metric registry
+    (per-protocol latency, request/byte/error counters, rate limits —
+    reference metric families: lodestar.ts reqResp.*)."""
 
     def __init__(self, metrics):
         self._metrics = metrics
 
     def observe_reqresp(self, protocol: str, seconds: float) -> None:
         self._metrics.reqresp_seconds.observe(seconds, protocol=protocol)
+
+    def incoming_request(self, protocol: str) -> None:
+        self._metrics.reqresp_incoming_requests_total.inc(protocol=protocol)
+
+    def incoming_error(self, protocol: str) -> None:
+        self._metrics.reqresp_incoming_errors_total.inc(protocol=protocol)
+
+    def outgoing_request(self, protocol: str) -> None:
+        self._metrics.reqresp_outgoing_requests_total.inc(protocol=protocol)
+
+    def outgoing_error(self, protocol: str) -> None:
+        self._metrics.reqresp_outgoing_errors_total.inc(protocol=protocol)
+
+    def bytes_sent(self, protocol: str, n: int) -> None:
+        self._metrics.reqresp_bytes_sent_total.inc(n, protocol=protocol)
+
+    def bytes_received(self, protocol: str, n: int) -> None:
+        self._metrics.reqresp_bytes_received_total.inc(n, protocol=protocol)
+
+    def rate_limited(self, limiter: str) -> None:
+        self._metrics.reqresp_rate_limited_total.inc(limiter=limiter)
+
+    def response_chunk(self, code: str, n: int = 1) -> None:
+        self._metrics.reqresp_response_chunks_total.inc(n, code=code)

@@ -83,7 +83,9 @@ class ReqRespService:
             await stream.reset()
             return
         peer_id = stream.conn.peer_id
+        self._hook("incoming_request", proto.value)
         if self.request_rate.request_objects(1) == 0:
+            self._hook("rate_limited", "requests")
             await stream.write(encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "rate limit"))
             await stream.close()
             self._penalize(peer_id, PeerAction.MidToleranceError)
@@ -97,6 +99,8 @@ class ReqRespService:
             log.debug(f"reqresp {proto.value} from {peer_id[:8]} failed: {e}")
             response = encode_error_chunk(RespCode.INVALID_REQUEST, str(e)[:64])
             self._penalize(peer_id, PeerAction.LowToleranceError)
+            self._hook("incoming_error", proto.value)
+        self._hook("bytes_sent", proto.value, len(response))
         try:
             await stream.write(response)
             await stream.close()
@@ -136,6 +140,7 @@ class ReqRespService:
             count = int.from_bytes(raw[8:16], "little")
             granted = self.block_rate.request_objects(min(count, 1024))
             if granted == 0:
+                self._hook("rate_limited", "blocks")
                 return encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "rate limit")
             return h.on_beacon_blocks_by_range(start_slot, count)
         if proto is Protocol.BeaconBlocksByRoot:
@@ -143,6 +148,7 @@ class ReqRespService:
             roots = [raw[i : i + 32] for i in range(0, len(raw), 32)]
             granted = self.block_rate.request_objects(max(1, len(roots)))
             if granted == 0:
+                self._hook("rate_limited", "blocks")
                 return encode_error_chunk(RespCode.RESOURCE_UNAVAILABLE, "rate limit")
             return h.on_beacon_blocks_by_root(roots)
         if proto is Protocol.LightClientBootstrap:
@@ -162,6 +168,11 @@ class ReqRespService:
         if self.peer_manager is not None:
             self.peer_manager.report_peer(peer_id, action)
 
+    def _hook(self, name: str, *args) -> None:
+        fn = getattr(self.metrics, name, None)
+        if fn is not None:
+            fn(*args)
+
     # ------------------------------------------------------------------ client
 
     async def _request_raw(
@@ -170,6 +181,7 @@ class ReqRespService:
         conn = self.transport.connections.get(peer_id)
         if conn is None:
             raise RequestError("DIAL_ERROR", f"no connection to {peer_id[:8]}")
+        self._hook("outgoing_request", proto.value)
         t0 = time.monotonic()
         stream = await conn.open_stream(protocol_id(proto, version))
         try:
@@ -183,15 +195,19 @@ class ReqRespService:
         except (TimeoutError, asyncio.TimeoutError):
             # asyncio.TimeoutError is a distinct class until 3.11
             self._penalize(peer_id, PeerAction.HighToleranceError)
+            self._hook("outgoing_error", proto.value)
             raise RequestError("RESP_TIMEOUT", proto.value) from None
         finally:
             await stream.reset()
         observe = getattr(self.metrics, "observe_reqresp", None)
         if observe is not None:
             observe(proto.value, time.monotonic() - t0)
+        self._hook("bytes_received", proto.value, len(first) + len(rest))
         chunks = decode_response_chunks(first + rest)
         for code, payload in chunks:
+            self._hook("response_chunk", code.name)
             if code != RespCode.SUCCESS:
+                self._hook("outgoing_error", proto.value)
                 raise RequestError(code.name, payload[:64].decode(errors="replace"))
         return chunks
 

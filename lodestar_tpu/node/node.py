@@ -64,6 +64,9 @@ class BeaconNode:
         # 2. metrics + per-validator monitor (reference validatorMonitor
         # wired at node init; register indices via monitor_validators())
         self.metrics = create_beacon_metrics()
+        from ..state_transition import stf as _stf
+
+        _stf.set_metrics(self.metrics)
         from ..metrics.validator_monitor import ValidatorMonitor
 
         self.validator_monitor = ValidatorMonitor(self.metrics.registry)
@@ -87,6 +90,8 @@ class BeaconNode:
             execution_engine=opts.execution_engine,
         )
         self.chain.metrics = self.metrics
+        if hasattr(self.db.db, "metrics"):
+            self.db.db.metrics = self.metrics
         self.chain.validator_monitor = self.validator_monitor
 
         # 3b. eth1 deposit follower (live JSON-RPC or mock; None = none)
@@ -110,7 +115,9 @@ class BeaconNode:
         self.metrics_server = None
         if opts.rest:
             impl = BeaconApiImpl(config, types, self.chain)
-            self.api_server = BeaconApiServer(impl, port=opts.rest_port)
+            self.api_server = BeaconApiServer(
+                impl, port=opts.rest_port, metrics=self.metrics
+            )
             self.api_server.start()
             self.log.info("REST API on :%d", self.api_server.port)
         if opts.metrics:
@@ -148,6 +155,18 @@ class BeaconNode:
         m = self.metrics
         m.head_slot.set(self.chain.head_state.state.slot)
         m.clock_slot.set(slot)
+        m.clock_epoch.set(slot // self.config.preset.SLOTS_PER_EPOCH)
+        m.head_distance.set(max(0, slot - self.chain.head_state.state.slot))
+        try:
+            m.active_validators.set(
+                len(
+                    self.chain.head_state.flat.active_indices(
+                        slot // self.config.preset.SLOTS_PER_EPOCH
+                    )
+                )
+            )
+        except Exception:
+            pass
         m.current_justified_epoch.set(self.chain.justified_checkpoint[0])
         m.finalized_epoch.set(self.chain.finalized_checkpoint[0])
         m.state_cache_size.set(len(self.chain.state_cache._cache))
@@ -156,6 +175,37 @@ class BeaconNode:
         m.proposer_boost_active.set(
             1 if self.chain.fork_choice.proposer_boost_root else 0
         )
+        for kind, cache in (
+            ("attesters", self.chain.seen_attesters),
+            ("aggregators", self.chain.seen_aggregators),
+            ("block_proposers", self.chain.seen_block_proposers),
+            ("aggregated", self.chain.seen_aggregated),
+            ("sync_committee", self.chain.seen_sync_committee),
+        ):
+            try:
+                m.seen_cache_size.set(len(cache._seen), kind=kind)
+            except (AttributeError, TypeError):
+                pass
+        verifier = getattr(self.chain, "bls_verifier", None)
+        inner = getattr(verifier, "inner", verifier)
+        cache = getattr(inner, "_h2c_cache", None)
+        if cache is not None:
+            m.h2c_cache_size.set(len(cache))
+        # 0 stalled / 1 syncing / 2 synced: synced = within one slot of
+        # the clock; stalled = behind AND head unchanged for >3 slots
+        head = self.chain.head_state.state.slot
+        if slot - head <= 1:
+            m.sync_status.set(2)
+            self._head_progress = (head, slot)
+        else:
+            last_head, last_slot = getattr(self, "_head_progress", (head, slot))
+            if head > last_head:
+                self._head_progress = (head, slot)
+                m.sync_status.set(1)
+            elif slot - last_slot > 3:
+                m.sync_status.set(0)
+            else:
+                m.sync_status.set(1)
         pool = self.chain.attestation_pool
         m.op_pool_size.set(
             sum(len(v) for v in pool._by_slot.values())

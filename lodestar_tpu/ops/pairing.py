@@ -94,6 +94,51 @@ def _line_and_double(t, xp_neg2, yp2, zp2, b3):
     return l0, l1, l2, t_next
 
 
+def _line_and_add_projq(t, q_proj, xp_neg2, yp2, zp2, b3):
+    """Fused chord line + FULL projective addition T+Q (Q projective).
+
+    Same line as `_line_and_add` scaled uniformly by Zq² (a subfield
+    factor, annihilated by the final exponentiation): with
+    θ' = Y·Zq − Yq·Z = Zq·θ and H' = X·Zq − Xq·Z = Zq·H,
+        l0 = θ'·Xq − Yq·H',  l1 = (Zq·θ')·(−xp),  l2 = (Zq·H')·yp.
+    Addition: RCB16 Algorithm 7 (a=0), both operands projective — the
+    grouped batch equation feeds Q lanes that come out of point sums
+    (projective), and one inversion per lane would dwarf the Miller loop.
+    Three stacked fp2 multiplies (8+6+9), mirroring the mixed variant."""
+    x, y, z = t
+    xq, yq, zq = q_proj
+    # stage A: RCB16 cross products + the four line cross terms
+    t0, t1, t2, u, yzq, yqz, xzq, xqz = _stack_mul(
+        [x, y, z, fp2.add(x, y), y, yq, x, xq],
+        [xq, yq, zq, fp2.add(xq, yq), zq, z, zq, z],
+    )
+    theta = fp2.sub(yzq, yqz)      # Zq·(Y − yq·Z)
+    h = fp2.sub(xzq, xqz)          # Zq·(X − xq·Z)
+    t3 = fp2.sub(u, fp2.add(t0, t1))
+    t4 = fp2.add(yzq, yqz)
+    y3p = fp2.add(xzq, xqz)
+    x3 = fp2.add(fp2.add(t0, t0), t0)
+    # stage B: b3 scalings + line products
+    t2b, th_xq, yq_h, thz, hz, y3 = _stack_mul(
+        [b3, theta, yq, zq, zq, b3], [t2, xq, h, theta, h, y3p]
+    )
+    l0 = fp2.sub(th_xq, yq_h)
+    z3 = fp2.add(t1, t2b)
+    t1m = fp2.sub(t1, t2b)
+    # stage C: addition outputs + the two line evaluations (+ optional l0·zp)
+    lhs = [t3, t4, y3, t1m, z3, x3, thz, hz]
+    rhs = [t1m, y3, x3, z3, t4, t3, xp_neg2, yp2]
+    if zp2 is not None:
+        lhs.append(l0)
+        rhs.append(zp2)
+    out = _stack_mul(lhs, rhs)
+    a, b, c, d, e, f, l1, l2 = out[:8]
+    if zp2 is not None:
+        l0 = out[8]
+    t_next = (fp2.sub(a, b), fp2.add(c, d), fp2.add(e, f))
+    return l0, l1, l2, t_next
+
+
 def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
     """Fused chord line + mixed addition T+Q for the Miller step.
 
@@ -138,17 +183,30 @@ def miller_loop(p_aff, q_aff):
     """f = conj(f_{|x|,Q}(P)) for P ∈ G1 affine (xp, yp limbs), Q ∈ G2
     affine ((2,32)-limb coords). Batched over leading axes; does NOT handle
     infinity — callers mask (see `pairing_check`)."""
-    return _miller_loop_impl(p_aff[0], p_aff[1], None, q_aff[0], q_aff[1])
+    return _miller_loop_impl(p_aff[0], p_aff[1], None, q_aff[0], q_aff[1], None)
 
 
 def miller_loop_projective(p_proj, q_aff):
     """Same as `miller_loop` but P = (Xp, Yp, Zp) homogeneous projective —
     equal post-final-exp, up to the Zp^k subfield scale (see `_line_dbl`).
     Zp = 0 lanes produce garbage; callers mask them."""
-    return _miller_loop_impl(p_proj[0], p_proj[1], p_proj[2], q_aff[0], q_aff[1])
+    return _miller_loop_impl(
+        p_proj[0], p_proj[1], p_proj[2], q_aff[0], q_aff[1], None
+    )
 
 
-def _miller_loop_impl(xp, yp, zp, xq, yq):
+def miller_loop_proj_pq(p_proj, q_proj):
+    """P AND Q homogeneous projective — equal post-final-exp up to Zp/Zq
+    subfield scales. The grouped batch equation's form: its Q lanes come
+    out of on-device point sums (projective), and a per-lane Fp2 inversion
+    (~570 sequential multiplies via Fermat) would dwarf the whole Miller
+    loop. Zp = 0 or Zq = 0 lanes produce garbage; callers mask them."""
+    return _miller_loop_impl(
+        p_proj[0], p_proj[1], p_proj[2], q_proj[0], q_proj[1], q_proj[2]
+    )
+
+
+def _miller_loop_impl(xp, yp, zp, xq, yq, zq):
     batch = jnp.broadcast_shapes(xp.shape[:-1], xq.shape[:-2])
     # Axon-backend workaround: rank-4 (unbatched) fp12 chains miscompile on
     # the experimental TPU platform (observed: final_exponentiation gives
@@ -156,7 +214,12 @@ def _miller_loop_impl(xp, yp, zp, xq, yq):
     # A unit batch axis costs nothing and keeps every deep chain batched.
     if batch == ():
         out = _miller_loop_impl(
-            xp[None], yp[None], None if zp is None else zp[None], xq[None], yq[None]
+            xp[None],
+            yp[None],
+            None if zp is None else zp[None],
+            xq[None],
+            yq[None],
+            None if zq is None else zq[None],
         )
         return out[0]
     xp = jnp.broadcast_to(xp, batch + xp.shape[-1:])
@@ -165,6 +228,8 @@ def _miller_loop_impl(xp, yp, zp, xq, yq):
         zp = jnp.broadcast_to(zp, batch + zp.shape[-1:])
     xq = jnp.broadcast_to(xq, batch + xq.shape[-2:])
     yq = jnp.broadcast_to(yq, batch + yq.shape[-2:])
+    if zq is not None:
+        zq = jnp.broadcast_to(zq, batch + zq.shape[-2:])
     # lift the G1 evaluation point into Fp2 once so its scalings join the
     # fused stacked multiplies of _line_and_double/_line_and_add
     xp_neg2 = _lift_fp(fp.neg(xp))
@@ -172,7 +237,7 @@ def _miller_loop_impl(xp, yp, zp, xq, yq):
     zp2 = None if zp is None else _lift_fp(zp)
     b3 = g2.b3
 
-    t0 = g2.from_affine(xq, yq)
+    t0 = g2.from_affine(xq, yq) if zq is None else (xq, yq, zq)
     f0 = fp12.one(batch)
 
     def step(carry, bit):
@@ -182,9 +247,14 @@ def _miller_loop_impl(xp, yp, zp, xq, yq):
 
         def with_add(operand):
             t_in, f_in = operand
-            a0, a1, a2, t_out = _line_and_add(
-                t_in, (xq, yq), xp_neg2, yp2, zp2, b3
-            )
+            if zq is None:
+                a0, a1, a2, t_out = _line_and_add(
+                    t_in, (xq, yq), xp_neg2, yp2, zp2, b3
+                )
+            else:
+                a0, a1, a2, t_out = _line_and_add_projq(
+                    t_in, (xq, yq, zq), xp_neg2, yp2, zp2, b3
+                )
             f_out = fp12.mul_by_line(f_in, a0, a1, a2)
             return t_out, f_out
 

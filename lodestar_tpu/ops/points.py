@@ -286,3 +286,58 @@ G1_GEN_X = jnp.asarray(fq_to_limbs(_g1_gen[0]))
 G1_GEN_Y = jnp.asarray(fq_to_limbs(_g1_gen[1]))
 G2_GEN_X = jnp.asarray(fq2_to_limbs(_g2_gen[0]))
 G2_GEN_Y = jnp.asarray(fq2_to_limbs(_g2_gen[1]))
+
+
+# --- ψ endomorphism on G2 (device tier) ------------------------------------
+#
+# ψ = untwist∘Frobenius∘twist acts on G2 as multiplication by the BLS
+# parameter z = X_PARAM (since p ≡ t−1 = z mod r): ψ(x, y) =
+# (c_x·conj(x), c_y·conj(y)) with the oracle's Budroni–Pintore constants
+# (bls/curve.py psi()). The grouped batch verifier splits its 64-bit
+# random coefficients as r = a + z·b (a, b 32-bit — still 2^-64 sound:
+# (a, b) ↦ a + z·b is injective, so r is uniform over 2^64 residues) and
+# trades half of every scalar-combination for one ψ application: 2 fp2
+# multiplies instead of 32 doubling steps.
+
+_PSI_CX_L = jnp.asarray(fq2_to_limbs(_curve._PSI_CX))
+_PSI_CY_L = jnp.asarray(fq2_to_limbs(_curve._PSI_CY))
+
+
+def g2_psi(p):
+    """ψ of a projective G2 point: (c_x·conj(X), c_y·conj(Y), conj(Z)).
+
+    Conjugation commutes with the projective quotient (it is Fp-linear),
+    so infinity maps to infinity and no normalization is needed."""
+    x, y, z = p
+    out = fp2.mul(
+        jnp.stack([fp2.conj(x), fp2.conj(y)], axis=0),
+        jnp.stack(
+            [
+                jnp.broadcast_to(_PSI_CX_L, x.shape),
+                jnp.broadcast_to(_PSI_CY_L, y.shape),
+            ],
+            axis=0,
+        ),
+    )
+    return (out[0], out[1], fp2.conj(z))
+
+
+def _neg_g1_pow2_table(nbits: int):
+    """Affine limb table of −[2^b]·g1, b = 0..nbits−1 (host-computed).
+
+    The grouped verifier's signature aggregate rides constant-G1 Miller
+    lanes: e(−g1, Σ 2^b·U_b) = Π_b e(−[2^b]g1, U_b), so the per-bit plane
+    sums never need a sequential Horner combine on device."""
+    import numpy as np
+
+    xs, ys = [], []
+    cur = _curve.PointG1.generator()
+    for _ in range(nbits):
+        aff = cur.to_affine()
+        xs.append(fq_to_limbs(aff[0]))
+        ys.append(fq_to_limbs(-aff[1]))
+        cur = cur.double()
+    return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+
+
+NEG_G1_POW2_X, NEG_G1_POW2_Y = _neg_g1_pow2_table(32)

@@ -26,12 +26,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import fp, fp12
-from ..ops.pairing import final_exponentiation, miller_loop_projective
-from ..ops.points import G1_GEN_X, G1_GEN_Y, g1, g2
-from .verifier import _fp12_product_tree, _g2_sum_tree
+from ..ops import fp, fp2, fp12, msm
+from ..ops.pairing import (
+    final_exponentiation,
+    miller_loop_proj_pq,
+    miller_loop_projective,
+)
+from ..ops.points import (
+    G1_GEN_X,
+    G1_GEN_Y,
+    NEG_G1_POW2_X,
+    NEG_G1_POW2_Y,
+    g1,
+    g2,
+    g2_psi,
+)
+from .verifier import HALF_BITS, _fp12_product_tree, _g2_sum_tree
 
-__all__ = ["make_sharded_verifier", "ShardedBlsVerifier"]
+__all__ = [
+    "make_sharded_verifier",
+    "ShardedBlsVerifier",
+    "make_sharded_grouped_verifier",
+    "ShardedGroupedVerifier",
+]
 
 
 def _local_body(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
@@ -88,6 +105,137 @@ def make_sharded_verifier(mesh: Mesh, axis: str = "dp"):
         return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid)
 
     return run
+
+
+# --- grouped (shared-signing-root) tier --------------------------------------
+
+
+def _grouped_local(
+    mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid
+):
+    """Per-chip slice of the GROUPED batch equation.
+
+    The root axis R is sharded: each chip owns R/n root-rows — their
+    pubkey bit-plane MSMs, Horner combines and (A_j, H_j)/(B_j, ψH_j)
+    Miller lanes are pure data parallelism. The signature aggregate's
+    bit-plane sums span the WHOLE batch: each chip reduces its slice to
+    64 partial G2 plane sums, one `all_gather` (64 projective points per
+    chip — the only cross-chip traffic besides the final Fp12 partials)
+    combines them, and the 64 constant −[2^b]g1 Miller lanes are split
+    64/n per chip so the pairing work shards too."""
+    r_loc, lanes = pk_x.shape[0], pk_x.shape[1]
+    n_loc = r_loc * lanes
+    ndev = lax.axis_size(mesh_axis)
+
+    pk = (pk_x, pk_y, fp.one((r_loc, lanes)))
+    pk = g1.select(valid, pk, g1.infinity((r_loc, lanes)))
+    bits = jnp.concatenate([a_bits, b_bits], axis=-1)
+
+    t_planes = msm.masked_plane_sums(g1, pk, bits)  # (64, r_loc)
+    tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in t_planes)
+    tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)
+    ab = msm.horner_pow2(g1, tp)  # (2, r_loc)
+    a_pt = tuple(c[0] for c in ab)
+    b_pt = tuple(c[1] for c in ab)
+
+    # local partial signature plane sums → all_gather → combine
+    sig = (
+        sig_x.reshape((n_loc,) + sig_x.shape[-2:]),
+        sig_y.reshape((n_loc,) + sig_y.shape[-2:]),
+        fp2.one((n_loc,)),
+    )
+    sig = g2.select(valid.reshape(n_loc), sig, g2.infinity((n_loc,)))
+    u_part = msm.masked_plane_sums(g2, sig, bits.reshape(n_loc, 2 * HALF_BITS))
+    u_all = tuple(
+        lax.all_gather(c, mesh_axis) for c in u_part
+    )  # (ndev, 64, …)
+    u_all = tuple(jnp.moveaxis(c, 0, 1) for c in u_all)  # (64, ndev, …)
+    u_planes = msm.tree_sum(g2, u_all)  # (64,) combined over chips
+    u_a = tuple(c[:HALF_BITS] for c in u_planes)
+    u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
+
+    # this chip's slice of the 64 constant lanes
+    per = (2 * HALF_BITS) // ndev
+    start = lax.axis_index(mesh_axis) * per
+    uq = tuple(
+        jnp.concatenate([ca, cb], 0) for ca, cb in zip(u_a, u_b)
+    )  # (64,) Q lanes in plane order
+    uq_loc = tuple(
+        lax.dynamic_slice_in_dim(c, start, per, axis=0) for c in uq
+    )
+    const_x = jnp.concatenate([NEG_G1_POW2_X, NEG_G1_POW2_X], 0)
+    const_y = jnp.concatenate([NEG_G1_POW2_Y, NEG_G1_POW2_Y], 0)
+    cx_loc = lax.dynamic_slice_in_dim(const_x, start, per, axis=0)
+    cy_loc = lax.dynamic_slice_in_dim(const_y, start, per, axis=0)
+
+    h = (msg_x, msg_y, fp2.one((r_loc,)))
+    psi_h = g2_psi(h)
+    px = jnp.concatenate([a_pt[0], b_pt[0], cx_loc], 0)
+    py = jnp.concatenate([a_pt[1], b_pt[1], cy_loc], 0)
+    pz = jnp.concatenate([a_pt[2], b_pt[2], fp.one((per,))], 0)
+    qx = jnp.concatenate([h[0], psi_h[0], uq_loc[0]], 0)
+    qy = jnp.concatenate([h[1], psi_h[1], uq_loc[1]], 0)
+    qz = jnp.concatenate([h[2], psi_h[2], uq_loc[2]], 0)
+
+    lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
+    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    fs = fp12.select(lane_ok, fs, fp12.one((2 * r_loc + per,)))
+    return _fp12_product_tree(fs)
+
+
+def _sharded_grouped_verify(mesh_axis, *args):
+    f_loc = _grouped_local(mesh_axis, *args)
+    f_all = lax.all_gather(f_loc, mesh_axis)  # (ndev, 2,3,2,32)
+    return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+
+
+def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
+    """jit-compiled sharded grouped batch-verify over `mesh`. The root
+    axis (axis 0 of pk/msg/sig/bits/valid) must be divisible by the mesh
+    size, and the mesh size must divide 64 (the constant-lane count)."""
+    ndev = mesh.devices.size
+    if (2 * HALF_BITS) % ndev != 0:
+        # a non-dividing mesh would silently drop constant Miller lanes
+        # and reject every valid batch — refuse loudly instead
+        raise ValueError(
+            f"mesh size {ndev} must divide {2 * HALF_BITS} (constant lanes)"
+        )
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid):
+        fn = jax.shard_map(
+            partial(_sharded_grouped_verify, axis),
+            mesh=mesh,
+            in_specs=(spec,) * 9,
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid)
+
+    return run
+
+
+class ShardedGroupedVerifier:
+    """Host wrapper for the sharded grouped kernel: places (R, L) grouped
+    arrays root-sharded onto the mesh."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.devices.size
+        self._run = make_sharded_grouped_verifier(mesh, axis)
+        self._sharding = NamedSharding(mesh, P(axis))
+
+    def verify_grouped(self, g, a_bits, b_bits) -> bool:
+        put = lambda x: jax.device_put(x, self._sharding)
+        return bool(
+            self._run(
+                put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
+                put(g.sig_x), put(g.sig_y), put(a_bits), put(b_bits),
+                put(g.valid),
+            )
+        )
 
 
 class ShardedBlsVerifier:

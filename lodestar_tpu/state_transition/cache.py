@@ -38,6 +38,7 @@ class FlatValidators:
         "pubkeys", "effective_balance", "slashed",
         "activation_eligibility_epoch", "activation_epoch",
         "exit_epoch", "withdrawable_epoch", "balances",
+        "withdrawal_credentials",
     )
 
     def __init__(self, state):
@@ -53,6 +54,13 @@ class FlatValidators:
         self.exit_epoch = np.array([v.exit_epoch for v in vs], U64)
         self.withdrawable_epoch = np.array([v.withdrawable_epoch for v in vs], U64)
         self.balances = np.array(state.balances, U64)
+        self.withdrawal_credentials = (
+            np.frombuffer(
+                b"".join(bytes(v.withdrawal_credentials) for v in vs), np.uint8
+            ).reshape(n, 32).copy()
+            if n
+            else np.zeros((0, 32), np.uint8)
+        )
 
     def __len__(self):
         return len(self.effective_balance)
@@ -74,6 +82,14 @@ class FlatValidators:
             self.withdrawable_epoch, U64(validator.withdrawable_epoch)
         )
         self.balances = np.append(self.balances, U64(balance))
+        self.withdrawal_credentials = np.concatenate(
+            [
+                self.withdrawal_credentials,
+                np.frombuffer(
+                    bytes(validator.withdrawal_credentials), np.uint8
+                ).reshape(1, 32),
+            ]
+        )
 
     def active_indices(self, epoch: int) -> np.ndarray:
         mask = util.active_mask(self.activation_epoch, self.exit_epoch, epoch)
@@ -87,6 +103,7 @@ class FlatValidators:
     def sync_to_state(self, state) -> None:
         """Write mutated columns back into the SSZ containers."""
         vs = state.validators
+        wc_bytes = self.withdrawal_credentials.tobytes()
         for i, v in enumerate(vs):
             v.effective_balance = int(self.effective_balance[i])
             v.slashed = bool(self.slashed[i])
@@ -94,6 +111,7 @@ class FlatValidators:
             v.activation_epoch = int(self.activation_epoch[i])
             v.exit_epoch = int(self.exit_epoch[i])
             v.withdrawable_epoch = int(self.withdrawable_epoch[i])
+            v.withdrawal_credentials = wc_bytes[32 * i : 32 * i + 32]
         state.balances = [int(b) for b in self.balances]
 
 
@@ -143,6 +161,10 @@ class EpochContext:
             self.pubkey_to_index[bytes(flat.pubkeys[i])] = i
 
     def _build_shuffling(self, state, flat: FlatValidators, epoch: int):
+        from . import stf as _stf
+
+        if _stf._METRICS is not None:
+            _stf._METRICS.shuffling_cache_misses_total.inc()
         active = flat.active_indices(epoch)
         seed = util.get_seed(state, epoch, DOMAIN_BEACON_ATTESTER, self.preset)
         shuffled = util.shuffle_list(active, seed, self.preset.SHUFFLE_ROUND_COUNT)
@@ -183,6 +205,10 @@ class EpochContext:
     def _shuffling_at(self, epoch: int) -> EpochShuffling:
         for sh in (self.previous, self.current, self.next):
             if sh is not None and sh.epoch == epoch:
+                from . import stf as _stf
+
+                if _stf._METRICS is not None:
+                    _stf._METRICS.shuffling_cache_hits_total.inc()
                 return sh
         raise ValueError(f"no shuffling cached for epoch {epoch}")
 
@@ -245,6 +271,19 @@ class CachedBeaconState:
             self.inactivity_scores = np.array(state.inactivity_scores, U64)
         self.epoch_ctx = EpochContext(config, self.preset)
         self.epoch_ctx.load_state(state, self.flat)
+        self._hasher = None
+
+    def hash_tree_root(self) -> bytes:
+        """State root via the incremental columnar hasher (bit-identical to
+        `state.hash_tree_root()`; re-hashes only dirty paths — the
+        reference's ViewDU commit+hashTreeRoot analog,
+        `stateTransition.ts:69-74`). Syncs flat columns first."""
+        self.sync_flat()
+        if self._hasher is None or self._hasher.state_class is not type(self.state):
+            from .hasher import StateHasher
+
+            self._hasher = StateHasher(self.state)
+        return self._hasher.root(self)
 
     def sync_flat(self) -> None:
         """Write every flat-array column back into the SSZ state (called

@@ -162,11 +162,17 @@ def process_bls_to_execution_change(cached, signed_change, verify_signatures=Tru
         pk = bls.PublicKey.from_bytes(bytes(change.from_bls_pubkey))
         sig = bls.Signature.from_bytes(bytes(signed_change.signature))
         _require(bls.verify(pk, root, sig), "bad bls_to_execution_change signature")
-    validator.withdrawal_credentials = (
+    new_wc = (
         ETH1_ADDRESS_WITHDRAWAL_PREFIX
         + b"\x00" * 11
         + bytes(change.to_execution_address)
     )
+    validator.withdrawal_credentials = new_wc
+    # keep the flat column in lockstep (it is the hashing source of truth
+    # and sync_to_state writes it back over the SSZ objects)
+    import numpy as np
+
+    cached.flat.withdrawal_credentials[idx] = np.frombuffer(new_wc, np.uint8)
 
 
 # --- epoch: historical summaries ---------------------------------------------

@@ -22,6 +22,17 @@ def fork_types(cached):
     return get_types(cached.preset).by_fork[cached.fork]
 
 
+_METRICS = None
+
+
+def set_metrics(m) -> None:
+    """Install the process-wide metric sink for STF timings (epoch
+    transitions + incremental state hashing — reference lodestar.ts
+    stfn.* epochTransition/hashTreeRoot timers)."""
+    global _METRICS
+    _METRICS = m
+
+
 def _process_epoch_for_fork(cached, types) -> None:
     if cached.is_altair:
         from .altair import process_epoch_altair
@@ -64,8 +75,17 @@ def _upgrade_at_epoch_boundary(cached) -> None:
 
 
 def process_slot(cached, types) -> None:
+    import time as _t
+
     state, p = cached.state, cached.preset
-    prev_state_root = state.hash_tree_root()
+    _t0 = _t.monotonic()
+    prev_state_root = cached.hash_tree_root()  # incremental (hasher.py)
+    if _METRICS is not None:
+        _METRICS.state_hash_seconds.observe(_t.monotonic() - _t0)
+        vh = getattr(cached, "_hasher", None)
+        vh = getattr(vh, "_validators", None)
+        if vh is not None:
+            _METRICS.state_hash_dirty_validators.observe(vh.last_dirty)
     state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
     if state.latest_block_header.state_root == b"\x00" * 32:
         state.latest_block_header.state_root = prev_state_root
@@ -83,7 +103,12 @@ def process_slots(cached, types, slot: int) -> None:
     while state.slot < slot:
         process_slot(cached, fork_types(cached))
         if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
+            import time as _t
+
+            _t0 = _t.monotonic()
             _process_epoch_for_fork(cached, fork_types(cached))
+            if _METRICS is not None:
+                _METRICS.epoch_transition_seconds.observe(_t.monotonic() - _t0)
             cached.sync_flat()
             state.slot += 1
             cached.epoch_ctx.rotate_epoch(state, cached.flat)
@@ -112,7 +137,7 @@ def state_transition(
     )
     cached.sync_flat()
     if verify_state_root:
-        got = cached.state.hash_tree_root()
+        got = cached.hash_tree_root()
         if got != bytes(block.state_root):
             raise BlockProcessingError(
                 f"state root mismatch: {got.hex()} != {bytes(block.state_root).hex()}"

@@ -25,7 +25,7 @@ class BackfillError(Exception):
 class BackfillSync:
     def __init__(
         self, config, types, db, anchor_block, anchor_state, verifier,
-        terminal_root: bytes | None = None,
+        terminal_root: bytes | None = None, metrics=None,
     ):
         """`anchor_block`: trusted signed block (checkpoint); `anchor_state`
         its post state (pubkey registry); `verifier`: IBlsVerifier;
@@ -36,6 +36,7 @@ class BackfillSync:
         self.types = types
         self.db = db
         self.verifier = verifier
+        self.metrics = metrics
         self.anchor = anchor_block
         self.terminal_root = terminal_root
         self._pubkeys = [bytes(v.pubkey) for v in anchor_state.validators]
@@ -90,6 +91,12 @@ class BackfillSync:
             start = max(1, self.oldest_slot - BACKFILL_BATCH_SLOTS)
             count = self.oldest_slot - start
             blocks = self._download_verified(start, count)
+            m = getattr(self, "metrics", None)
+            if m is not None:
+                m.backfill_batches_total.inc(
+                    outcome="verified" if blocks else "empty"
+                )
+                m.backfill_slot.set(self.oldest_slot)
             if not blocks:
                 if start == 1:
                     break  # chain has no blocks below oldest_slot — done

@@ -45,12 +45,25 @@ class RangeSyncError(Exception):
 
 
 class RangeSync:
-    def __init__(self, chain, types, slots_per_epoch: int, verify_signatures: bool = True):
+    def __init__(
+        self, chain, types, slots_per_epoch: int, verify_signatures: bool = True,
+        metrics=None,
+    ):
         self.chain = chain
         self.types = types
         self.spe = slots_per_epoch
         self.verify_signatures = verify_signatures
         self.peers: list[IPeer] = []
+        self.metrics = metrics
+
+    def _export_batch_states(self, batches) -> None:
+        if self.metrics is None:
+            return
+        counts: dict[str, int] = {s.value: 0 for s in BatchStatus}
+        for b in batches:
+            counts[b.status.value] = counts.get(b.status.value, 0) + 1
+        for state, n in counts.items():
+            self.metrics.sync_batches_in_state.set(n, state=state)
 
     def add_peer(self, peer: IPeer) -> None:
         self.peers.append(peer)
@@ -84,8 +97,10 @@ class RangeSync:
             start += count
 
         for batch in batches:
+            self._export_batch_states(batches)
             self._download(batch)
             self._process(batch)
+            self._export_batch_states(batches)
         return self.chain.head_state.state.slot
 
     def _download(self, batch: SyncBatch) -> None:
@@ -108,7 +123,10 @@ class RangeSync:
         )
 
     def _process(self, batch: SyncBatch) -> None:
+        import time as _time
+
         batch.status = BatchStatus.PROCESSING
+        t0 = _time.monotonic()
         try:
             # segment import: the WHOLE batch's signature sets verify as
             # one batched dispatch (reference verifyBlocksSignatures —
@@ -117,9 +135,15 @@ class RangeSync:
                 batch.blocks, verify_signatures=self.verify_signatures
             )
             batch.status = BatchStatus.PROCESSED
+            if self.metrics is not None:
+                self.metrics.sync_range_batches_total.inc(outcome="processed")
+                self.metrics.sync_blocks_imported_total.inc(len(batch.blocks))
+                self.metrics.sync_segment_seconds.observe(_time.monotonic() - t0)
         except Exception as e:
             # a bad segment sends the batch back for re-download from a
             # different peer (reference: batch retry on processing failure)
             batch.failed_attempts += 1
             batch.status = BatchStatus.FAILED
+            if self.metrics is not None:
+                self.metrics.sync_range_batches_total.inc(outcome="failed")
             raise RangeSyncError(f"processing failed: {e}") from e

@@ -31,7 +31,8 @@ class ProposerDuty:
 
 
 class ValidatorService:
-    def __init__(self, config, types, chain, store: ValidatorStore):
+    def __init__(self, config, types, chain, store: ValidatorStore, metrics=None):
+        self.metrics = metrics
         self.config = config
         self.types = types
         self.chain = chain
@@ -109,10 +110,24 @@ class ValidatorService:
         pk = by_index.get(proposer)
         if pk is None:
             return None
+        import time as _t
+
+        _t0 = _t.monotonic()
         reveal = self.store.sign_randao(pk, slot)
         block = self.chain.produce_block(slot, randao_reveal=reveal)
         signed = self.store.sign_block(pk, self.types, block)
-        self.chain.process_block(signed)
+        if self.metrics is not None:
+            self.metrics.vc_signer_seconds.observe(
+                _t.monotonic() - _t0, kind="block"
+            )
+        try:
+            self.chain.process_block(signed)
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.vc_duties_total.inc(kind="block", outcome="error")
+            raise
+        if self.metrics is not None:
+            self.metrics.vc_duties_total.inc(kind="block", outcome="published")
         return signed
 
     def attest_if_due(self, slot: int) -> list:
@@ -152,7 +167,17 @@ class ValidatorService:
             sigs = []
             bits = [False] * len(committee)
             for pk, idx in ours:
+                import time as _t
+
+                _t0 = _t.monotonic()
                 sig = self.store.sign_attestation(pk, data)
+                if self.metrics is not None:
+                    self.metrics.vc_signer_seconds.observe(
+                        _t.monotonic() - _t0, kind="attestation"
+                    )
+                    self.metrics.vc_duties_total.inc(
+                        kind="attestation", outcome="signed"
+                    )
                 sigs.append(bls.Signature.from_bytes(sig, validate=False))
                 bits[members[idx]] = True
             att = self.types.Attestation(

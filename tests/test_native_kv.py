@@ -301,3 +301,35 @@ def test_multi_segment_compaction_invalidates_read_fd_cache(tmp_path, monkeypatc
     for k, v in values.items():
         assert db.get(k) == v
     db.close()
+
+
+def test_stale_compact_tmp_cannot_resurrect_deleted_keys(tmp_path):
+    """Round-3 review: a previously-failed compaction leaves segments in
+    compact.tmp; the next compaction must purge them, not replay them —
+    otherwise a key deleted since the failed run comes back to life."""
+    import shutil
+
+    from lodestar_tpu.db.controller import NativeKvDb
+
+    path = str(tmp_path / "kv")
+    db = NativeKvDb(path)
+    db.put(b"victim", b"old-value")
+    db.put(b"keeper", b"kept")
+    # fabricate a failed compaction: its tmp dir holds a full copy of the
+    # current (pre-delete) generation
+    tmp = os.path.join(path, "compact.tmp")
+    os.makedirs(tmp, exist_ok=True)
+    for name in os.listdir(path):
+        if name.startswith("seg-") and name.endswith(".kv"):
+            shutil.copy(os.path.join(path, name), os.path.join(tmp, name))
+    # the key is deleted AFTER the (simulated) failed compaction
+    db.delete(b"victim")
+    db.compact()
+    assert db.get(b"victim") is None, "deleted key resurrected from stale tmp"
+    assert db.get(b"keeper") == b"kept"
+    # survives a reopen too
+    db.close()
+    db2 = NativeKvDb(path)
+    assert db2.get(b"victim") is None
+    assert db2.get(b"keeper") == b"kept"
+    db2.close()

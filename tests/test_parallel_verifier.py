@@ -102,3 +102,81 @@ def test_bucket_padding_does_not_flip_verdict(verifier):
     assert verifier.verify_signature_sets(sets)
     res = verifier.verify_signature_sets_individual(sets)
     assert res == [True] * 5
+
+
+# --- grouped (shared-signing-root) path ------------------------------------
+
+
+def _make_shared_root_sets(n, n_roots, salt=0):
+    """n sets over n_roots distinct messages — committee gossip shape."""
+    sets = []
+    for i in range(n):
+        sk = bls.interop_secret_key(i + salt)
+        msg = bytes([(i % n_roots) ^ 0x3C]) * 32
+        sets.append(
+            bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+    return sets
+
+
+@pytest.fixture(scope="module")
+def grouped_verifier():
+    return TpuBlsVerifier(
+        buckets=(4, 16), rng=_det_rng, grouped_configs=((4, 4),)
+    )
+
+
+def test_grouped_path_selected_for_shared_roots(grouped_verifier):
+    sets = _make_shared_root_sets(10, 3)
+    plan = grouped_verifier._plan_groups(sets)
+    assert plan is not None
+    rows_cap, lane_cap, runs = plan
+    assert (rows_cap, lane_cap) == (4, 4)
+    assert sorted(i for run in runs for i in run) == list(range(10))
+    assert all(len(run) <= lane_cap for run in runs)
+    # a root with >lane_cap sets splits across rows; one root here has 4,
+    # the others 3 — 3 rows total
+    assert len(runs) == 3
+
+
+def test_flat_path_for_unique_roots(grouped_verifier):
+    sets = _make_sets(3)  # all-distinct messages
+    assert grouped_verifier._plan_groups(sets) is None
+
+
+def test_grouped_verify_valid(grouped_verifier):
+    sets = _make_shared_root_sets(10, 3)
+    assert bls.verify_signature_sets(sets)  # oracle agrees
+    assert grouped_verifier.verify_signature_sets(sets)
+
+
+def test_grouped_verify_detects_one_bad(grouped_verifier):
+    sets = _make_shared_root_sets(10, 3)
+    wrong = bls.interop_secret_key(99)
+    sets[4] = bls.SignatureSet(
+        pubkey=sets[4].pubkey,
+        message=sets[4].message,
+        signature=wrong.sign(sets[4].message).to_bytes(),
+    )
+    assert not grouped_verifier.verify_signature_sets(sets)
+
+
+def test_grouped_row_split_beyond_lane_cap(grouped_verifier):
+    # 13 sets on ONE root: lane_cap 4 → 4 rows, same message repeated —
+    # bilinearity over repeated roots must not change the verdict
+    sets = _make_shared_root_sets(13, 1, salt=50)
+    plan = grouped_verifier._plan_groups(sets)
+    assert plan is not None and len(plan[2]) == 4
+    assert grouped_verifier.verify_signature_sets(sets)
+
+
+def test_grouped_malformed_signature_rejected(grouped_verifier):
+    sets = _make_shared_root_sets(8, 2)
+    sets[1] = bls.SignatureSet(
+        pubkey=sets[1].pubkey, message=sets[1].message, signature=b"\x00" * 96
+    )
+    assert not grouped_verifier.verify_signature_sets(sets)

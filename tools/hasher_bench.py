@@ -1,0 +1,99 @@
+"""Measure incremental state hashing at mainnet scale (BASELINE Missing #2).
+
+Builds 1M-validator flat columns directly (no SSZ object graph — the
+columnar hasher never walks one) and times:
+  1. first full build of the validators+balances trees,
+  2. re-hash after ONE balance change (the O(log n) path),
+  3. re-hash after one epoch-shaped sweep (every effective_balance row
+     touched — the worst realistic case).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lodestar_tpu.state_transition.hasher import _ValidatorsHasher, _u64_chunks
+from lodestar_tpu.ssz.tree_cache import ChunkTree
+from lodestar_tpu.ssz.hashing import mix_in_length
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+REGISTRY_LIMIT = 1 << 40
+
+
+class _Cols:
+    pass
+
+
+def main():
+    rng = np.random.default_rng(1)
+    flat = _Cols()
+    flat.pubkeys = [bytes([i % 251, (i >> 8) % 251]) + b"\x22" * 46 for i in range(N)]
+    flat.effective_balance = np.full(N, 32_000_000_000, np.uint64)
+    flat.slashed = np.zeros(N, bool)
+    flat.activation_eligibility_epoch = np.zeros(N, np.uint64)
+    flat.activation_epoch = np.zeros(N, np.uint64)
+    flat.exit_epoch = np.full(N, (1 << 64) - 1, np.uint64)
+    flat.withdrawable_epoch = np.full(N, (1 << 64) - 1, np.uint64)
+    flat.withdrawal_credentials = rng.integers(
+        0, 256, size=(N, 32), dtype=np.int64
+    ).astype(np.uint8)
+    balances = rng.integers(31_000_000_000, 33_000_000_000, size=N, dtype=np.uint64)
+
+    class FlatLike:
+        withdrawal_credentials = flat.withdrawal_credentials
+        pubkeys = flat.pubkeys
+        effective_balance = flat.effective_balance
+        slashed = flat.slashed
+        activation_eligibility_epoch = flat.activation_eligibility_epoch
+        activation_epoch = flat.activation_epoch
+        exit_epoch = flat.exit_epoch
+        withdrawable_epoch = flat.withdrawable_epoch
+
+        def __len__(self):
+            return N
+
+    fl = FlatLike()
+    vh = _ValidatorsHasher(REGISTRY_LIMIT)
+    bt = ChunkTree((REGISTRY_LIMIT + 3) // 4)
+
+    t0 = time.perf_counter()
+    r0 = vh.root(fl)
+    bt.update(_u64_chunks(balances))
+    b0 = mix_in_length(bt.root(), N)
+    t_full = time.perf_counter() - t0
+    print(f"full build ({N} validators): {t_full:.2f}s")
+
+    balances[N // 2] += 1
+    t0 = time.perf_counter()
+    r1 = vh.root(fl)
+    bt.update(_u64_chunks(balances))
+    b1 = mix_in_length(bt.root(), N)
+    t_one = time.perf_counter() - t0
+    assert r1 == r0 and b1 != b0
+    print(f"one balance change: {t_one*1e3:.1f} ms")
+
+    flat.effective_balance[:] = rng.integers(
+        31_000_000_000, 33_000_000_000, size=N, dtype=np.uint64
+    ) // 1_000_000_000 * 1_000_000_000
+    t0 = time.perf_counter()
+    vh.root(fl)
+    t_sweep = time.perf_counter() - t0
+    print(f"all-effective-balance sweep: {t_sweep:.2f}s")
+    import json
+
+    print(json.dumps({
+        "n_validators": N,
+        "full_build_s": round(t_full, 3),
+        "one_change_ms": round(t_one * 1e3, 2),
+        "epoch_sweep_s": round(t_sweep, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
