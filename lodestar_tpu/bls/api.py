@@ -87,6 +87,16 @@ class SecretKey:
         return PublicKey(PointG1.generator() * self.value)
 
     def sign(self, message: bytes, dst: bytes = DST_G2) -> "Signature":
+        from .. import native as _native
+
+        if _native.HAVE_NATIVE_BLS:
+            # C tier: hash-to-curve + G2 scalar mul (~6x the oracle);
+            # byte-identical output, differential-tested
+            rc, sig96 = _native.bls_sign(
+                self.value.to_bytes(32, "big"), message, dst
+            )
+            if rc == 0:
+                return Signature.from_bytes(sig96, validate=False)
         return Signature(hash_to_g2(message, dst) * self.value)
 
 
@@ -219,9 +229,24 @@ def verify(
 ) -> bool:
     """CoreVerify: e(pk, H(m)) == e(g1, sig), i.e.
     e(pk, H(m)) · e(−g1, sig) == 1. Infinity pubkey/signature → False
-    (eth2 semantics)."""
+    (eth2 semantics).
+
+    Fast path: the native C pairing (~10 ms vs ~2 s for the big-int
+    oracle) — every one-off verification (gossip objects, deposits,
+    voluntary exits) rides it; the oracle stays as the fallback and the
+    differential reference."""
     if pubkey.point.is_infinity() or signature.point.is_infinity():
         return False
+    from .. import native as _native
+
+    if _native.HAVE_NATIVE_BLS:
+        try:
+            out = _native.bls_verify_sets(
+                pubkey.to_bytes(), [message], g2_to_bytes(signature.point), dst
+            )
+            return bool(out[0])
+        except (ValueError, OSError):
+            pass  # malformed re-serialization — fall through to the oracle
     h = hash_to_g2(message, dst)
     return _pairing_check([(pubkey.point, h), (_NEG_G1, signature.point)])
 

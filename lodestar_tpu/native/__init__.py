@@ -68,7 +68,7 @@ def _build() -> bool:
     out = os.path.join(_HERE, "_lodestar_native" + ext_suffix)
     include = sysconfig.get_paths()["include"]
     cmd = [
-        os.environ.get("CC", "cc"), "-O2", "-shared", "-fPIC",
+        os.environ.get("CC", "cc"), "-O3", "-funroll-loops", "-shared", "-fPIC",
         f"-I{include}", *src, "-o", out,
     ]
     try:
@@ -212,6 +212,33 @@ def bls_hash_to_g2(msg: bytes, dst: bytes):
 
     rc, buf = _mod.bls_hash_to_g2(msg, dst)
     return rc, np.frombuffer(buf, np.int32).reshape(2, 2, 32)
+
+
+def bls_sign(sk_be: bytes, msg: bytes, dst: bytes):
+    """[sk]·H(msg) → (rc, 96B compressed G2 signature)."""
+    return _mod.bls_sign(sk_be, msg, dst)
+
+
+def bls_verify_sets(pks: bytes, msgs: list[bytes], sigs: bytes, dst: bytes,
+                    h_x=None, h_y=None):
+    """Full CPU verification of n signature sets: decompress + subgroup
+    checks + hash-to-curve + two pairings per set, in C with the GIL
+    released (the production fallback tier — reference: blst C verify
+    behind maybeBatch.ts). `h_x`/`h_y` ((n, 2, 32) int32 device limbs):
+    precomputed H(m) from the signing-root cache, skipping per-set
+    hashing. Returns a list[bool] of per-set verdicts."""
+    import numpy as np
+
+    lens = b"".join(len(m).to_bytes(8, "little") for m in msgs)
+    if h_x is not None and h_y is not None:
+        ok = _mod.bls_verify_sets(
+            pks, b"".join(msgs), lens, sigs, dst,
+            np.ascontiguousarray(h_x, np.int32).tobytes(),
+            np.ascontiguousarray(h_y, np.int32).tobytes(),
+        )
+    else:
+        ok = _mod.bls_verify_sets(pks, b"".join(msgs), lens, sigs, dst)
+    return [bool(b) for b in ok]
 
 
 def bls_g1_aggregate(pks: bytes, check_each: bool = True):

@@ -663,10 +663,10 @@ int lodestar_bls_g1_decompress(const uint8_t in[48], int32_t out_x[32],
   return 0;
 }
 
-int lodestar_bls_g2_decompress(const uint8_t in[96], int32_t out_x[64],
-                               int32_t out_y[64], int check_subgroup) {
-  memset(out_x, 0, 64 * sizeof(int32_t));
-  memset(out_y, 0, 64 * sizeof(int32_t));
+/* parse a compressed G2 point to affine Montgomery coordinates.
+ * Returns 0 ok / 1 infinity / -1 malformed / -2 off-curve / -3 subgroup. */
+static int g2_parse_compressed_aff(const uint8_t in[96], fp2 *x, fp2 *y,
+                                   int check_subgroup) {
   uint8_t flags = in[0];
   if (!(flags & FLAG_C)) return -1;
   if (flags & FLAG_I) {
@@ -681,23 +681,33 @@ int lodestar_bls_g2_decompress(const uint8_t in[96], int32_t out_x[64],
   uint64_t x1w[6], x0w[6];
   if (!fp_from_be(x1w, buf)) return -1;       /* first 48B: c1 (ZCash order) */
   if (!fp_from_be(x0w, in + 48)) return -1;   /* second 48B: c0 */
-  fp2 x, y, y2, t;
-  fp_to_mont(x.c0, x0w);
-  fp_to_mont(x.c1, x1w);
-  fp2_sqr(&t, &x);
-  fp2_mul(&t, &t, &x);
+  fp2 y2, t;
+  fp_to_mont(x->c0, x0w);
+  fp_to_mont(x->c1, x1w);
+  fp2_sqr(&t, x);
+  fp2_mul(&t, &t, x);
   fp2 b2;
   memcpy(&b2, BLS_B2_M, sizeof(fp2));
   fp2_add(&y2, &t, &b2);
-  if (!fp2_sqrt(&y, &y2)) return -2;
-  if (fp2_lex_larger(&y) != !!(flags & FLAG_S)) fp2_neg(&y, &y);
+  if (!fp2_sqrt(y, &y2)) return -2;
+  if (fp2_lex_larger(y) != !!(flags & FLAG_S)) fp2_neg(y, y);
   if (check_subgroup) {
     g2p p;
-    fp2_copy(&p.X, &x);
-    fp2_copy(&p.Y, &y);
+    fp2_copy(&p.X, x);
+    fp2_copy(&p.Y, y);
     fp2_one(&p.Z);
     if (!g2_in_subgroup(&p)) return -3;
   }
+  return 0;
+}
+
+int lodestar_bls_g2_decompress(const uint8_t in[96], int32_t out_x[64],
+                               int32_t out_y[64], int check_subgroup) {
+  memset(out_x, 0, 64 * sizeof(int32_t));
+  memset(out_y, 0, 64 * sizeof(int32_t));
+  fp2 x, y;
+  int rc = g2_parse_compressed_aff(in, &x, &y, check_subgroup);
+  if (rc != 0) return rc;
   fp_to_limbs12(out_x, x.c0);
   fp_to_limbs12(out_x + 32, x.c1);
   fp_to_limbs12(out_y, y.c0);
@@ -845,9 +855,9 @@ static void map_to_curve_g2(g2p *out, const fp2 *u) {
   fp2_one(&out->Z);
 }
 
-int lodestar_bls_hash_to_g2(const uint8_t *msg, size_t msg_len,
-                            const uint8_t *dst, size_t dst_len,
-                            int32_t out_x[64], int32_t out_y[64]) {
+/* hash-to-curve returning affine Montgomery coordinates. */
+static int hash_to_g2_aff(const uint8_t *msg, size_t msg_len,
+                          const uint8_t *dst, size_t dst_len, fp2 *x, fp2 *y) {
   if (msg_len > 3000 || dst_len == 0 || dst_len > 255) return -1;
   uint8_t uniform[256];
   expand_message_xmd_256(msg, msg_len, dst, dst_len, uniform);
@@ -862,12 +872,624 @@ int lodestar_bls_hash_to_g2(const uint8_t *msg, size_t msg_len,
   g2_add(&q, &q0, &q1);
   g2_clear_cofactor(&q, &q);
   if (g2_is_infinity(&q)) return -2;  /* astronomically unlikely */
+  g2_to_affine(x, y, &q);
+  return 0;
+}
+
+int lodestar_bls_hash_to_g2(const uint8_t *msg, size_t msg_len,
+                            const uint8_t *dst, size_t dst_len,
+                            int32_t out_x[64], int32_t out_y[64]) {
   fp2 x, y;
-  g2_to_affine(&x, &y, &q);
+  int rc = hash_to_g2_aff(msg, msg_len, dst, dst_len, &x, &y);
+  if (rc != 0) return rc;
   fp_to_limbs12(out_x, x.c0);
   fp_to_limbs12(out_x + 32, x.c1);
   fp_to_limbs12(out_y, y.c0);
   fp_to_limbs12(out_y + 32, y.c1);
+  return 0;
+}
+
+/* ---------------- pairing (optimal ate, host tier) ----------------
+ *
+ * The CPU verification fallback: without this the only non-device verify
+ * path was the Python big-int oracle (~1 s/pairing) — any device outage
+ * or the individual-retry path under attack traffic would collapse the
+ * node.  Tower Fp2[v]/(v^3 - xi), xi = 1+u, then Fp6[w]/(w^2 - v) —
+ * the same tower as the device tier (ops/fp6, ops/fp12) and the oracle
+ * (bls/fields), so the Frobenius gamma tables are shared via
+ * gen_bls12_consts.py.  Reference analog: blst's C pairing behind
+ * verifyMultipleSignatures (chain/bls/maybeBatch.ts).
+ */
+
+typedef struct { fp2 c0, c1, c2; } fp6;
+typedef struct { fp6 c0, c1; } fp12;
+
+static void fp2_mul_xi(fp2 *r, const fp2 *a) {
+  /* (1+u)(a0 + a1 u) = (a0 - a1) + (a0 + a1) u */
+  fp t0, t1;
+  fp_sub(t0, a->c0, a->c1);
+  fp_add(t1, a->c0, a->c1);
+  fp_copy(r->c0, t0);
+  fp_copy(r->c1, t1);
+}
+
+static void fp6_add(fp6 *r, const fp6 *a, const fp6 *b) {
+  fp2_add(&r->c0, &a->c0, &b->c0);
+  fp2_add(&r->c1, &a->c1, &b->c1);
+  fp2_add(&r->c2, &a->c2, &b->c2);
+}
+static void fp6_sub(fp6 *r, const fp6 *a, const fp6 *b) {
+  fp2_sub(&r->c0, &a->c0, &b->c0);
+  fp2_sub(&r->c1, &a->c1, &b->c1);
+  fp2_sub(&r->c2, &a->c2, &b->c2);
+}
+static void fp6_neg(fp6 *r, const fp6 *a) {
+  fp2_neg(&r->c0, &a->c0);
+  fp2_neg(&r->c1, &a->c1);
+  fp2_neg(&r->c2, &a->c2);
+}
+static void fp6_zero(fp6 *r) { fp2_zero(&r->c0); fp2_zero(&r->c1); fp2_zero(&r->c2); }
+static void fp6_one(fp6 *r) { fp2_one(&r->c0); fp2_zero(&r->c1); fp2_zero(&r->c2); }
+static int fp6_is_zero(const fp6 *a) {
+  return fp2_is_zero(&a->c0) && fp2_is_zero(&a->c1) && fp2_is_zero(&a->c2);
+}
+
+static void fp6_mul(fp6 *r, const fp6 *a, const fp6 *b) {
+  /* schoolbook with v^3 = xi */
+  fp2 v0, v1, v2, t, s;
+  fp2_mul(&v0, &a->c0, &b->c0);
+  fp2_mul(&v1, &a->c1, &b->c1);
+  fp2_mul(&v2, &a->c2, &b->c2);
+  fp6 out;
+  /* c0 = v0 + xi((a1+a2)(b1+b2) - v1 - v2) */
+  fp2 a12, b12;
+  fp2_add(&a12, &a->c1, &a->c2);
+  fp2_add(&b12, &b->c1, &b->c2);
+  fp2_mul(&t, &a12, &b12);
+  fp2_sub(&t, &t, &v1);
+  fp2_sub(&t, &t, &v2);
+  fp2_mul_xi(&t, &t);
+  fp2_add(&out.c0, &v0, &t);
+  /* c1 = (a0+a1)(b0+b1) - v0 - v1 + xi v2 */
+  fp2_add(&a12, &a->c0, &a->c1);
+  fp2_add(&b12, &b->c0, &b->c1);
+  fp2_mul(&t, &a12, &b12);
+  fp2_sub(&t, &t, &v0);
+  fp2_sub(&t, &t, &v1);
+  fp2_mul_xi(&s, &v2);
+  fp2_add(&out.c1, &t, &s);
+  /* c2 = (a0+a2)(b0+b2) - v0 - v2 + v1 */
+  fp2_add(&a12, &a->c0, &a->c2);
+  fp2_add(&b12, &b->c0, &b->c2);
+  fp2_mul(&t, &a12, &b12);
+  fp2_sub(&t, &t, &v0);
+  fp2_sub(&t, &t, &v2);
+  fp2_add(&out.c2, &t, &v1);
+  *r = out;
+}
+static void fp6_sqr(fp6 *r, const fp6 *a) { fp6_mul(r, a, a); }
+
+static void fp6_mul_by_v(fp6 *r, const fp6 *a) {
+  /* v(c0 + c1 v + c2 v^2) = xi c2 + c0 v + c1 v^2 */
+  fp2 t;
+  fp2_mul_xi(&t, &a->c2);
+  fp2 c0 = a->c0, c1 = a->c1;
+  fp2_copy(&r->c0, &t);
+  fp2_copy(&r->c1, &c0);
+  fp2_copy(&r->c2, &c1);
+}
+
+static void fp6_inv(fp6 *r, const fp6 *a) {
+  /* standard: c0 = a0^2 - xi a1 a2, c1 = xi a2^2 - a0 a1,
+   * c2 = a1^2 - a0 a2; t = a0 c0 + xi(a2 c1 + a1 c2); r = c_i / t */
+  fp2 c0, c1, c2, t, s;
+  fp2_sqr(&c0, &a->c0);
+  fp2_mul(&t, &a->c1, &a->c2);
+  fp2_mul_xi(&t, &t);
+  fp2_sub(&c0, &c0, &t);
+  fp2_sqr(&c1, &a->c2);
+  fp2_mul_xi(&c1, &c1);
+  fp2_mul(&t, &a->c0, &a->c1);
+  fp2_sub(&c1, &c1, &t);
+  fp2_sqr(&c2, &a->c1);
+  fp2_mul(&t, &a->c0, &a->c2);
+  fp2_sub(&c2, &c2, &t);
+  fp2_mul(&t, &a->c0, &c0);
+  fp2_mul(&s, &a->c2, &c1);
+  fp2 s2;
+  fp2_mul(&s2, &a->c1, &c2);
+  fp2_add(&s, &s, &s2);
+  fp2_mul_xi(&s, &s);
+  fp2_add(&t, &t, &s);
+  fp2 tinv;
+  fp2_inv(&tinv, &t);
+  fp2_mul(&r->c0, &c0, &tinv);
+  fp2_mul(&r->c1, &c1, &tinv);
+  fp2_mul(&r->c2, &c2, &tinv);
+}
+
+static void fp12_one(fp12 *r) { fp6_one(&r->c0); fp6_zero(&r->c1); }
+static void fp12_conj(fp12 *r, const fp12 *a) {
+  r->c0 = a->c0;
+  fp6_neg(&r->c1, &a->c1);
+}
+static int fp12_is_one(const fp12 *a) {
+  fp2 one;
+  fp2_one(&one);
+  return fp2_eq(&a->c0.c0, &one) && fp2_is_zero(&a->c0.c1) &&
+         fp2_is_zero(&a->c0.c2) && fp6_is_zero(&a->c1);
+}
+
+static void fp12_mul(fp12 *r, const fp12 *a, const fp12 *b) {
+  fp6 v0, v1, t, s;
+  fp6_mul(&v0, &a->c0, &b->c0);
+  fp6_mul(&v1, &a->c1, &b->c1);
+  fp6_add(&t, &a->c0, &a->c1);
+  fp6_add(&s, &b->c0, &b->c1);
+  fp6_mul(&t, &t, &s);           /* (a0+a1)(b0+b1) */
+  fp6_sub(&t, &t, &v0);
+  fp6_sub(&t, &t, &v1);          /* c1 */
+  fp6_mul_by_v(&s, &v1);
+  fp6_add(&r->c0, &v0, &s);
+  r->c1 = t;
+}
+static void fp12_sqr(fp12 *r, const fp12 *a) {
+  /* complex squaring: c0 = (a0+a1)(a0+v a1) - v0 - v v0, c1 = 2 v0 */
+  fp6 v0, t0, t1;
+  fp6_mul(&v0, &a->c0, &a->c1);
+  fp6_add(&t0, &a->c0, &a->c1);
+  fp6_mul_by_v(&t1, &a->c1);
+  fp6_add(&t1, &a->c0, &t1);
+  fp6_mul(&t0, &t0, &t1);        /* (a0+a1)(a0 + v a1) */
+  fp6_sub(&t0, &t0, &v0);
+  fp6_mul_by_v(&t1, &v0);
+  fp6_sub(&r->c0, &t0, &t1);
+  fp6_add(&r->c1, &v0, &v0);
+}
+
+static void fp12_inv(fp12 *r, const fp12 *a) {
+  /* (c0 - c1 w) / (c0^2 - v c1^2) */
+  fp6 t0, t1;
+  fp6_sqr(&t0, &a->c0);
+  fp6_sqr(&t1, &a->c1);
+  fp6_mul_by_v(&t1, &t1);
+  fp6_sub(&t0, &t0, &t1);
+  fp6_inv(&t0, &t0);
+  fp6_mul(&r->c0, &a->c0, &t0);
+  fp6_mul(&t1, &a->c1, &t0);
+  fp6_neg(&r->c1, &t1);
+}
+
+/* sparse line multiply: f *= l0 + l1 w^2 + l2 w^3, i.e. in the fp6 pair
+ * view A = (l0, l1, 0), B = (0, l2, 0) with f' = (f0 A + v f1 B,
+ * (f0+f1)(A+B) - f0 A - f1 B)  [same layout as device ops/fp12.mul_by_line] */
+static void fp6_mul_sparse01(fp6 *r, const fp6 *f, const fp2 *a0, const fp2 *a1) {
+  /* f * (a0 + a1 v) */
+  fp2 t0, t1, t2, s;
+  fp6 out;
+  fp2_mul(&t0, &f->c0, a0);
+  fp2_mul(&t1, &f->c2, a1);
+  fp2_mul_xi(&s, &t1);
+  fp2_add(&out.c0, &t0, &s);
+  fp2_mul(&t0, &f->c0, a1);
+  fp2_mul(&t1, &f->c1, a0);
+  fp2_add(&out.c1, &t0, &t1);
+  fp2_mul(&t1, &f->c1, a1);
+  fp2_mul(&t2, &f->c2, a0);
+  fp2_add(&out.c2, &t1, &t2);
+  *r = out;
+}
+static void fp6_mul_sparse1(fp6 *r, const fp6 *f, const fp2 *b1) {
+  /* f * (b1 v) */
+  fp2 t;
+  fp6 out;
+  fp2_mul(&t, &f->c2, b1);
+  fp2_mul_xi(&out.c0, &t);
+  fp2_mul(&out.c1, &f->c0, b1);
+  fp2_mul(&out.c2, &f->c1, b1);
+  *r = out;
+}
+static void fp12_mul_by_line(fp12 *f, const fp2 *l0, const fp2 *l1,
+                             const fp2 *l2) {
+  fp6 t0, t1, t2, g;
+  fp2 s;
+  fp6_mul_sparse01(&t0, &f->c0, l0, l1);     /* f0 * A */
+  fp6_mul_sparse1(&t1, &f->c1, l2);          /* f1 * B */
+  fp6_add(&g, &f->c0, &f->c1);
+  fp2_add(&s, l1, l2);
+  fp6_mul_sparse01(&t2, &g, l0, &s);         /* (f0+f1)(A+B) */
+  fp6 vt1;
+  fp6_mul_by_v(&vt1, &t1);
+  fp6_add(&f->c0, &t0, &vt1);
+  fp6_sub(&t2, &t2, &t0);
+  fp6_sub(&f->c1, &t2, &t1);
+}
+
+/* Frobenius x^(p^k), k = 1..3, via the shared gamma tables: w-coefficient
+ * view d = (c00, c10, c01, c11, c02, c12), conj each for odd k, then
+ * d_i *= gamma_k[i] (same construction as device ops/fp12.frobenius). */
+static void fp12_frobenius(fp12 *r, const fp12 *a, int k) {
+  const uint64_t (*gam)[2][6] =
+      k == 1 ? BLS_FROB_G1 : (k == 2 ? BLS_FROB_G2 : BLS_FROB_G3);
+  const fp2 *d[6] = {&a->c0.c0, &a->c1.c0, &a->c0.c1,
+                     &a->c1.c1, &a->c0.c2, &a->c1.c2};
+  fp2 *o[6] = {&r->c0.c0, &r->c1.c0, &r->c0.c1,
+               &r->c1.c1, &r->c0.c2, &r->c1.c2};
+  for (int i = 0; i < 6; i++) {
+    fp2 t;
+    if (k & 1) fp2_conj(&t, d[i]);
+    else fp2_copy(&t, d[i]);
+    fp2 g;
+    memcpy(&g, gam[i], sizeof(fp2));
+    fp2_mul(o[i], &t, &g);
+  }
+}
+
+/* Granger–Scott cyclotomic squaring (valid after the easy part) — the
+ * same three-Fp4 formulas as device ops/fp12.cyclotomic_square. */
+static void fp12_cyclotomic_sqr(fp12 *r, const fp12 *g) {
+  const fp2 *a = &g->c0.c0, *b = &g->c0.c1, *c = &g->c0.c2;
+  const fp2 *d = &g->c1.c0, *e = &g->c1.c1, *f = &g->c1.c2;
+  fp2 a2, e2, c2, d2, b2, f2, t, t0, t2, t4, t6, t7, t8;
+  fp2_sqr(&a2, a); fp2_sqr(&e2, e); fp2_sqr(&c2, c);
+  fp2_sqr(&d2, d); fp2_sqr(&b2, b); fp2_sqr(&f2, f);
+  /* t6 = 2ae, t7 = 2cd, t8 = 2bf*xi via (x+y)^2 - x^2 - y^2 */
+  fp2_add(&t, a, e); fp2_sqr(&t, &t); fp2_sub(&t, &t, &a2); fp2_sub(&t6, &t, &e2);
+  fp2_add(&t, c, d); fp2_sqr(&t, &t); fp2_sub(&t, &t, &c2); fp2_sub(&t7, &t, &d2);
+  fp2_add(&t, b, f); fp2_sqr(&t, &t); fp2_sub(&t, &t, &b2); fp2_sub(&t, &t, &f2);
+  fp2_mul_xi(&t8, &t);
+  fp2_mul_xi(&t, &e2); fp2_add(&t0, &t, &a2);     /* t0 = a^2 + xi e^2 */
+  fp2_mul_xi(&t, &c2); fp2_add(&t2, &t, &d2);     /* t2 = d^2 + xi c^2 */
+  fp2_mul_xi(&t, &f2); fp2_add(&t4, &t, &b2);     /* t4 = b^2 + xi f^2 */
+  fp12 out;
+  /* c0' = (3t0 - 2a, 3t2 - 2b, 3t4 - 2c); c1' = (3t8+2d, 3t6+2e, 3t7+2f) */
+  fp2 y;
+#define GS_MINUS(dst, tv, xv)                                                  \
+  do {                                                                         \
+    fp2_sub(&y, &(tv), (xv));                                                  \
+    fp2_add(&y, &y, &y);                                                       \
+    fp2_add(&(dst), &y, &(tv));                                                \
+  } while (0)
+#define GS_PLUS(dst, tv, xv)                                                   \
+  do {                                                                         \
+    fp2_add(&y, &(tv), (xv));                                                  \
+    fp2_add(&y, &y, &y);                                                       \
+    fp2_add(&(dst), &y, &(tv));                                                \
+  } while (0)
+  GS_MINUS(out.c0.c0, t0, a);
+  GS_MINUS(out.c0.c1, t2, b);
+  GS_MINUS(out.c0.c2, t4, c);
+  GS_PLUS(out.c1.c0, t8, d);
+  GS_PLUS(out.c1.c1, t6, e);
+  GS_PLUS(out.c1.c2, t7, f);
+#undef GS_MINUS
+#undef GS_PLUS
+  *r = out;
+}
+
+/* line + double / line + add on homogeneous projective T (ported 1:1 from
+ * device ops/pairing._line_and_double/_line_and_add, affine-P variant). */
+static void pair_line_dbl(fp2 *l0, fp2 *l1, fp2 *l2, g2p *t,
+                          const fp xp_neg, const fp yp) {
+  fp2 xx, yy, zz, yz, xy, xxx, yyz, xxz, yzz, t2b, b2;
+  memcpy(&b2, BLS_B2_M, sizeof(fp2));
+  fp2 b3;
+  fp2_add(&b3, &b2, &b2);
+  fp2_add(&b3, &b3, &b2);
+  fp2_sqr(&xx, &t->X);
+  fp2_sqr(&yy, &t->Y);
+  fp2_sqr(&zz, &t->Z);
+  fp2_mul(&yz, &t->Y, &t->Z);
+  fp2_mul(&xy, &t->X, &t->Y);
+  fp2_mul(&xxx, &xx, &t->X);
+  fp2_mul(&yyz, &yy, &t->Z);
+  fp2_mul(&xxz, &xx, &t->Z);
+  fp2_mul(&yzz, &yz, &t->Z);
+  fp2_mul(&t2b, &b3, &zz);
+  /* l0 = 3X^3 - 2Y^2 Z */
+  fp2 s;
+  fp2_add(l0, &xxx, &xxx);
+  fp2_add(l0, l0, &xxx);
+  fp2_add(&s, &yyz, &yyz);
+  fp2_sub(l0, l0, &s);
+  /* l1 = 3X^2 Z * (-xp),  l2 = 2YZ^2 * yp */
+  fp2 three_xxz, two_yzz;
+  fp2_add(&three_xxz, &xxz, &xxz);
+  fp2_add(&three_xxz, &three_xxz, &xxz);
+  fp2_mul_fp(l1, &three_xxz, xp_neg);
+  fp2_add(&two_yzz, &yzz, &yzz);
+  fp2_mul_fp(l2, &two_yzz, yp);
+  /* double (RCB16 alg 9): */
+  fp2 z8, y3s, t0c;
+  fp2_add(&z8, &yy, &yy);
+  fp2_add(&z8, &z8, &z8);
+  fp2_add(&z8, &z8, &z8);                 /* 8Y^2 */
+  fp2_add(&y3s, &yy, &t2b);
+  fp2_add(&s, &t2b, &t2b);
+  fp2_add(&s, &s, &t2b);
+  fp2_sub(&t0c, &yy, &s);                 /* Y^2 - 3 b3 Z^2 */
+  fp2 x3, z3, y3m, xt;
+  fp2_mul(&x3, &t2b, &z8);
+  fp2_mul(&z3, &yz, &z8);
+  fp2_mul(&y3m, &t0c, &y3s);
+  fp2_mul(&xt, &t0c, &xy);
+  fp2_add(&t->X, &xt, &xt);
+  fp2_add(&t->Y, &x3, &y3m);
+  fp2_copy(&t->Z, &z3);
+}
+
+static void pair_line_add(fp2 *l0, fp2 *l1, fp2 *l2, g2p *t,
+                          const fp2 *xq, const fp2 *yq, const fp xp_neg,
+                          const fp yp) {
+  fp2 b2, b3;
+  memcpy(&b2, BLS_B2_M, sizeof(fp2));
+  fp2_add(&b3, &b2, &b2);
+  fp2_add(&b3, &b3, &b2);
+  fp2 t0, t1, u, xqz, yqz, b3z, s;
+  fp2_mul(&t0, &t->X, xq);
+  fp2_mul(&t1, &t->Y, yq);
+  fp2_add(&u, &t->X, &t->Y);
+  fp2_add(&s, xq, yq);
+  fp2_mul(&u, &u, &s);                       /* (X+Y)(xq+yq) */
+  fp2_mul(&xqz, xq, &t->Z);
+  fp2_mul(&yqz, yq, &t->Z);
+  fp2_mul(&b3z, &b3, &t->Z);
+  fp2 theta, h;
+  fp2_sub(&theta, &t->Y, &yqz);              /* Y - yq Z */
+  fp2_sub(&h, &t->X, &xqz);                  /* X - xq Z */
+  /* lines: l0 = theta xq - yq h, l1 = theta(-xp), l2 = h yp */
+  fp2 thxq, yqh;
+  fp2_mul(&thxq, &theta, xq);
+  fp2_mul(&yqh, yq, &h);
+  fp2_sub(l0, &thxq, &yqh);
+  fp2_mul_fp(l1, &theta, xp_neg);
+  fp2_mul_fp(l2, &h, yp);
+  /* mixed addition (RCB16 alg 8) */
+  fp2 t3, y3p, t4, x3, z3, t1m, y3;
+  fp2_sub(&t3, &u, &t0);
+  fp2_sub(&t3, &t3, &t1);                    /* xy cross */
+  fp2_add(&y3p, &xqz, &t->X);
+  fp2_add(&t4, &yqz, &t->Y);
+  fp2_add(&x3, &t0, &t0);
+  fp2_add(&x3, &x3, &t0);                    /* 3 X xq */
+  fp2_add(&z3, &t1, &b3z);
+  fp2_sub(&t1m, &t1, &b3z);
+  fp2_mul(&y3, &b3, &y3p);
+  fp2 a_, b_, c_, d_, e_, f_;
+  fp2_mul(&a_, &t3, &t1m);
+  fp2_mul(&b_, &t4, &y3);
+  fp2_mul(&c_, &y3, &x3);
+  fp2_mul(&d_, &t1m, &z3);
+  fp2_mul(&e_, &z3, &t4);
+  fp2_mul(&f_, &x3, &t3);
+  fp2_sub(&t->X, &a_, &b_);
+  fp2_add(&t->Y, &c_, &d_);
+  fp2_add(&t->Z, &e_, &f_);
+}
+
+/* f = conj(f_{|x|,Q}(P)) for P = (xp, yp) affine G1, Q affine G2 —
+ * same convention as the oracle/device tiers. */
+static void miller_loop_c(fp12 *f, const fp xp, const fp yp, const fp2 *xq,
+                          const fp2 *yq) {
+  fp xp_neg;
+  fp_neg(xp_neg, xp);
+  g2p t;
+  fp2_copy(&t.X, xq);
+  fp2_copy(&t.Y, yq);
+  fp2_one(&t.Z);
+  fp12_one(f);
+  uint64_t x_abs = BLS_X_ABS[0];
+  int top = 63;
+  while (!((x_abs >> top) & 1)) top--;
+  fp2 l0, l1, l2;
+  for (int i = top - 1; i >= 0; i--) {
+    fp12_sqr(f, f);
+    pair_line_dbl(&l0, &l1, &l2, &t, xp_neg, yp);
+    fp12_mul_by_line(f, &l0, &l1, &l2);
+    if ((x_abs >> i) & 1) {
+      pair_line_add(&l0, &l1, &l2, &t, xq, yq, xp_neg, yp);
+      fp12_mul_by_line(f, &l0, &l1, &l2);
+    }
+  }
+  fp12_conj(f, f);  /* x < 0 */
+}
+
+static void fp12_pow_x_abs(fp12 *r, const fp12 *g) {
+  /* g^|x| with cyclotomic squarings (g is in the cyclotomic subgroup) */
+  fp12 acc = *g;
+  uint64_t x_abs = BLS_X_ABS[0];
+  int top = 63;
+  while (!((x_abs >> top) & 1)) top--;
+  for (int i = top - 1; i >= 0; i--) {
+    fp12_cyclotomic_sqr(&acc, &acc);
+    if ((x_abs >> i) & 1) fp12_mul(&acc, &acc, g);
+  }
+  *r = acc;
+}
+static void fp12_pow_x(fp12 *r, const fp12 *g) {
+  fp12_pow_x_abs(r, g);
+  fp12_conj(r, r);  /* x negative */
+}
+
+/* final exponentiation — easy part then the HHT hard part; computes
+ * pairing^3 exactly like the oracle/device (harmless for ==1 checks). */
+static void final_exp_c(fp12 *r, const fp12 *f_in) {
+  fp12 f, t;
+  fp12_conj(&f, f_in);
+  fp12_inv(&t, f_in);
+  fp12_mul(&f, &f, &t);            /* f^(p^6 - 1) */
+  fp12_frobenius(&t, &f, 2);
+  fp12_mul(&f, &t, &f);            /* ^(p^2 + 1): cyclotomic now */
+  /* a = pxm1(pxm1(f)), pxm1(g) = g^x * conj(g) */
+  fp12 a, b, c, s;
+  fp12_pow_x(&a, &f);
+  fp12_conj(&t, &f);
+  fp12_mul(&a, &a, &t);
+  fp12_pow_x(&s, &a);
+  fp12_conj(&t, &a);
+  fp12_mul(&a, &s, &t);
+  /* b = a^x * frob1(a) */
+  fp12_pow_x(&b, &a);
+  fp12_frobenius(&t, &a, 1);
+  fp12_mul(&b, &b, &t);
+  /* c = b^(x^2) * frob2(b) * conj(b) */
+  fp12_pow_x(&c, &b);
+  fp12_pow_x(&c, &c);
+  fp12_frobenius(&t, &b, 2);
+  fp12_mul(&c, &c, &t);
+  fp12_conj(&t, &b);
+  fp12_mul(&c, &c, &t);
+  /* result = c * f^3 */
+  fp12_sqr(&t, &f);
+  fp12_mul(&t, &t, &f);
+  fp12_mul(r, &c, &t);
+}
+
+/* dual Miller loop: f = conj(f_{|x|,Q1}(P1) * f_{|x|,Q2}(P2)) — ONE
+ * shared fp12 squaring chain for both pairs (the squarings dominate;
+ * a multi-pairing halves them vs two separate loops). */
+static void miller_loop2_c(fp12 *f, const fp p1x, const fp p1y,
+                           const fp2 *q1x, const fp2 *q1y, const fp p2x,
+                           const fp p2y, const fp2 *q2x, const fp2 *q2y) {
+  fp p1x_neg, p2x_neg;
+  fp_neg(p1x_neg, p1x);
+  fp_neg(p2x_neg, p2x);
+  g2p t1, t2;
+  fp2_copy(&t1.X, q1x); fp2_copy(&t1.Y, q1y); fp2_one(&t1.Z);
+  fp2_copy(&t2.X, q2x); fp2_copy(&t2.Y, q2y); fp2_one(&t2.Z);
+  fp12_one(f);
+  uint64_t x_abs = BLS_X_ABS[0];
+  int top = 63;
+  while (!((x_abs >> top) & 1)) top--;
+  fp2 l0, l1, l2;
+  for (int i = top - 1; i >= 0; i--) {
+    fp12_sqr(f, f);
+    pair_line_dbl(&l0, &l1, &l2, &t1, p1x_neg, p1y);
+    fp12_mul_by_line(f, &l0, &l1, &l2);
+    pair_line_dbl(&l0, &l1, &l2, &t2, p2x_neg, p2y);
+    fp12_mul_by_line(f, &l0, &l1, &l2);
+    if ((x_abs >> i) & 1) {
+      pair_line_add(&l0, &l1, &l2, &t1, q1x, q1y, p1x_neg, p1y);
+      fp12_mul_by_line(f, &l0, &l1, &l2);
+      pair_line_add(&l0, &l1, &l2, &t2, q2x, q2y, p2x_neg, p2y);
+      fp12_mul_by_line(f, &l0, &l1, &l2);
+    }
+  }
+  fp12_conj(f, f);  /* x < 0 */
+}
+
+/* one signature set: e(pk, H(m)) * e(-g1, sig) == 1 */
+static int pairing_verify_one(const fp pk_x, const fp pk_y, const fp2 *h_x,
+                              const fp2 *h_y, const fp2 *sig_x,
+                              const fp2 *sig_y) {
+  fp12 f;
+  fp g1x, g1y_neg, gy;
+  memcpy(g1x, BLS_G1_GX, sizeof(fp));
+  memcpy(gy, BLS_G1_GY, sizeof(fp));
+  fp_neg(g1y_neg, gy);
+  miller_loop2_c(&f, pk_x, pk_y, h_x, h_y, g1x, g1y_neg, sig_x, sig_y);
+  final_exp_c(&f, &f);
+  return fp12_is_one(&f);
+}
+
+/* reassemble a field element from 32x12-bit device limbs (they carry the
+ * Montgomery form directly — fp_to_limbs12 is the inverse). */
+static void fp_from_limbs12(fp r, const int32_t in[32]) {
+  uint64_t w[8];
+  memset(w, 0, sizeof(w));
+  for (int i = 0; i < 32; i++) {
+    uint64_t v = (uint64_t)(uint32_t)in[i] & 0xFFF;
+    int bit = 12 * i;
+    w[bit / 64] |= v << (bit % 64);
+    if ((bit % 64) > 52) w[bit / 64 + 1] |= v >> (64 - bit % 64);
+  }
+  memcpy(r, w, sizeof(fp));
+}
+
+/* Verify n signature sets on the CPU (pubkey 48B, 32B signing root,
+ * signature 96B per set); out_ok[i] = 1 iff set i verifies.  The
+ * production fallback/oracle tier (reference: blst verify in
+ * chain/bls/maybeBatch.ts) — ~10 ms/set/core on this host vs the Python
+ * oracle's ~2 s/set.  h_x/h_y non-NULL: per-set hash-to-curve device
+ * limbs from the caller's signing-root cache (gossip shares roots, so
+ * hashing dominates otherwise); msgs/msg_lens may then be NULL. */
+int lodestar_bls_verify_sets(size_t n, const uint8_t *pks,
+                             const uint8_t *msgs, const size_t *msg_lens,
+                             const uint8_t *sigs, const uint8_t *dst,
+                             size_t dst_len, const int32_t *h_x,
+                             const int32_t *h_y, uint8_t *out_ok) {
+  size_t msg_off = 0;
+  for (size_t i = 0; i < n; i++) {
+    out_ok[i] = 0;
+    g1p pk;
+    int rc = g1_parse_compressed(pks + 48 * i, &pk);
+    if (rc != 0) continue;                 /* infinity pk invalid (KeyValidate) */
+    if (!g1_in_subgroup(&pk)) continue;
+    fp2 sx, sy;
+    rc = g2_parse_compressed_aff(sigs + 96 * i, &sx, &sy, 1);
+    if (rc != 0) continue;                 /* infinity sig never verifies */
+    fp2 hx, hy;
+    if (h_x != NULL && h_y != NULL) {
+      fp_from_limbs12(hx.c0, h_x + 64 * i);
+      fp_from_limbs12(hx.c1, h_x + 64 * i + 32);
+      fp_from_limbs12(hy.c0, h_y + 64 * i);
+      fp_from_limbs12(hy.c1, h_y + 64 * i + 32);
+    } else {
+      const uint8_t *msg = msgs + msg_off;
+      size_t msg_len = msg_lens[i];
+      msg_off += msg_len;
+      if (hash_to_g2_aff(msg, msg_len, dst, dst_len, &hx, &hy) != 0) continue;
+    }
+    fp pkx, pky;
+    g1_to_affine(pkx, pky, &pk);
+    out_ok[i] = (uint8_t)pairing_verify_one(pkx, pky, &hx, &hy, &sx, &sy);
+  }
+  return 0;
+}
+
+/* ---------------- signing ----------------
+ *
+ * sign = [sk]·H(m): the host-tier signer (the Python oracle's G2 scalar
+ * mul + hash costs ~50 ms/signature, which dominates every multi-epoch
+ * simulation in the test suite; this is ~6x). */
+
+static void fp_to_be48(uint8_t out[48], const uint64_t w[6]) {
+  for (int i = 0; i < 6; i++)
+    for (int b = 0; b < 8; b++)
+      out[48 - 8 * i - 1 - b] = (uint8_t)(w[i] >> (8 * b));
+}
+
+int lodestar_bls_sign(const uint8_t sk_be[32], const uint8_t *msg,
+                      size_t msg_len, const uint8_t *dst, size_t dst_len,
+                      uint8_t out[96]) {
+  uint64_t k[4];
+  for (int i = 0; i < 4; i++) {
+    uint64_t v = 0;
+    for (int b = 0; b < 8; b++) v = (v << 8) | sk_be[8 * i + b];
+    k[3 - i] = v;
+  }
+  /* 0 < sk < r */
+  int all_zero = !(k[0] | k[1] | k[2] | k[3]);
+  if (all_zero || fp_cmp_ge(k, BLS_ORDER_R, 4)) return -1;
+  fp2 hx, hy;
+  int rc = hash_to_g2_aff(msg, msg_len, dst, dst_len, &hx, &hy);
+  if (rc != 0) return rc;
+  g2p h, s;
+  fp2_copy(&h.X, &hx);
+  fp2_copy(&h.Y, &hy);
+  fp2_one(&h.Z);
+  g2_scalar_mul(&s, &h, k, 4);
+  if (g2_is_infinity(&s)) return -2;  /* impossible for valid sk */
+  fp2 x, y;
+  g2_to_affine(&x, &y, &s);
+  /* ZCash compressed: 48B c1 (flags in byte 0) then 48B c0, both BE */
+  uint64_t w[6];
+  fp_from_mont(w, x.c1);
+  fp_to_be48(out, w);
+  fp_from_mont(w, x.c0);
+  fp_to_be48(out + 48, w);
+  out[0] |= FLAG_C;
+  if (fp2_lex_larger(&y)) out[0] |= FLAG_S;
   return 0;
 }
 

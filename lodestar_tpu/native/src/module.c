@@ -276,6 +276,105 @@ done:
 }
 
 
+int lodestar_bls_verify_sets(size_t n, const uint8_t *pks,
+                             const uint8_t *msgs, const size_t *msg_lens,
+                             const uint8_t *sigs, const uint8_t *dst,
+                             size_t dst_len, const int32_t *h_x,
+                             const int32_t *h_y, uint8_t *out_ok);
+
+int lodestar_bls_sign(const uint8_t sk_be[32], const uint8_t *msg,
+                      size_t msg_len, const uint8_t *dst, size_t dst_len,
+                      uint8_t out[96]);
+
+static PyObject *py_bls_sign(PyObject *self, PyObject *args) {
+  Py_buffer sk, msg, dst;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &sk, &msg, &dst)) return NULL;
+  if (sk.len != 32) {
+    PyBuffer_Release(&sk); PyBuffer_Release(&msg); PyBuffer_Release(&dst);
+    PyErr_SetString(PyExc_ValueError, "secret key must be 32 bytes");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(NULL, 96);
+  if (!out) {
+    PyBuffer_Release(&sk); PyBuffer_Release(&msg); PyBuffer_Release(&dst);
+    return NULL;
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = lodestar_bls_sign((const uint8_t *)sk.buf, (const uint8_t *)msg.buf,
+                         (size_t)msg.len, (const uint8_t *)dst.buf,
+                         (size_t)dst.len, (uint8_t *)PyBytes_AS_STRING(out));
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&sk);
+  PyBuffer_Release(&msg);
+  PyBuffer_Release(&dst);
+  return Py_BuildValue("(iN)", rc, out);
+}
+
+static PyObject *py_bls_verify_sets(PyObject *self, PyObject *args) {
+  /* (pks n*48B, msgs concatenated, msg_lens n*8B LE, sigs n*96B, dst)
+   * -> n verdict bytes.  Full CPU verification: decompress + subgroup +
+   * hash-to-curve + two pairings per set (GIL released). */
+  Py_buffer pks, msgs, lens, sigs, dst;
+  Py_buffer hx = {0}, hy = {0};
+  if (!PyArg_ParseTuple(args, "y*y*y*y*y*|y*y*", &pks, &msgs, &lens, &sigs,
+                        &dst, &hx, &hy))
+    return NULL;
+  Py_ssize_t n = pks.len / 48;
+  PyObject *ok = NULL;
+  size_t *ml = NULL;
+  if (pks.len % 48 != 0 || lens.len != n * 8 || sigs.len != n * 96) {
+    PyErr_SetString(PyExc_ValueError,
+                    "need n*48 pubkey, n*8 length, n*96 signature bytes");
+    goto done;
+  }
+  ml = malloc(sizeof(size_t) * (n ? n : 1));
+  if (!ml) {
+    PyErr_NoMemory();
+    goto done;
+  }
+  {
+    const uint8_t *lp = (const uint8_t *)lens.buf;
+    size_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      uint64_t v = 0;
+      for (int b = 0; b < 8; b++) v |= (uint64_t)lp[8 * i + b] << (8 * b);
+      ml[i] = (size_t)v;
+      total += ml[i];
+    }
+    if ((Py_ssize_t)total != msgs.len) {
+      PyErr_SetString(PyExc_ValueError, "message lengths disagree with buffer");
+      goto done;
+    }
+  }
+  ok = PyBytes_FromStringAndSize(NULL, n);
+  if (!ok) goto done;
+  {
+    uint8_t *okp = (uint8_t *)PyBytes_AS_STRING(ok);
+    const int32_t *hx_p =
+        hx.buf != NULL && hx.len == n * 64 * 4 ? (const int32_t *)hx.buf : NULL;
+    const int32_t *hy_p =
+        hy.buf != NULL && hy.len == n * 64 * 4 ? (const int32_t *)hy.buf : NULL;
+    Py_BEGIN_ALLOW_THREADS
+    lodestar_bls_verify_sets((size_t)n, (const uint8_t *)pks.buf,
+                             (const uint8_t *)msgs.buf, ml,
+                             (const uint8_t *)sigs.buf,
+                             (const uint8_t *)dst.buf, (size_t)dst.len,
+                             hx_p, hy_p, okp);
+    Py_END_ALLOW_THREADS
+  }
+done:
+  free(ml);
+  PyBuffer_Release(&pks);
+  PyBuffer_Release(&msgs);
+  PyBuffer_Release(&lens);
+  PyBuffer_Release(&sigs);
+  PyBuffer_Release(&dst);
+  if (hx.buf) PyBuffer_Release(&hx);
+  if (hy.buf) PyBuffer_Release(&hy);
+  return ok;
+}
+
 /* ---- persistent KV engine (kvstore.c) ---- */
 
 typedef struct kv_store kv_store;
@@ -540,6 +639,10 @@ static PyMethodDef methods[] = {
      "hash_to_curve G2 (RFC 9380) -> (rc, x||y device limbs int32[128])"},
     {"bls_g1_aggregate", py_bls_g1_aggregate, METH_VARARGS,
      "N*48B pubkeys -> (rc, x||y device limbs of the sum)"},
+    {"bls_sign", py_bls_sign, METH_VARARGS,
+     "sign a message: [sk]H(m) -> 96B compressed G2"},
+    {"bls_verify_sets", py_bls_verify_sets, METH_VARARGS,
+     "full CPU verification of n signature sets (two pairings per set)"},
     {"bls_marshal_sets", py_bls_marshal_sets, METH_VARARGS,
      "batch: pubkeys/messages/signatures -> (device limb buffer, ok flags)"},
     {"kv_open", py_kv_open, METH_VARARGS, "open/replay a KV datadir -> handle"},

@@ -191,3 +191,41 @@ def test_fast_subgroup_checks_reject_nonmembers():
     assert rc == -3, rc
     rc, _ = native.bls_g2_decompress(g2_nonmember, False)
     assert rc == 0
+
+
+def test_native_verify_sets_matches_oracle():
+    """The C pairing (round-3: dual Miller + cyclotomic final exp) must
+    agree with the big-int oracle on valid, tampered, and edge inputs."""
+    import numpy as np
+
+    from lodestar_tpu import native
+    from lodestar_tpu.bls import api as bls
+
+    if not native.HAVE_NATIVE_BLS:
+        import pytest
+
+        pytest.skip("native extension unavailable")
+    sk0, sk1 = bls.interop_secret_key(0), bls.interop_secret_key(1)
+    msg = b"\x42" * 32
+    pk = sk0.to_public_key().to_bytes()
+    good = sk0.sign(msg).to_bytes()
+    wrong = sk1.sign(msg).to_bytes()
+    inf_sig = bytes([0xC0]) + b"\x00" * 95
+
+    ok = native.bls_verify_sets(
+        pk * 3, [msg, msg, b"\x43" * 32], good + wrong + good, bls.DST_G2
+    )
+    assert ok == [True, False, False]
+    # infinity signature never verifies
+    assert native.bls_verify_sets(pk, [msg], inf_sig, bls.DST_G2) == [False]
+    # precomputed-H path agrees
+    rc, h = native.bls_hash_to_g2(msg, bls.DST_G2)
+    assert rc == 0
+    ok2 = native.bls_verify_sets(
+        pk * 2, [msg, msg], good + wrong, bls.DST_G2,
+        np.stack([h, h])[:, 0], np.stack([h, h])[:, 1],
+    )
+    assert ok2 == [True, False]
+    # api.verify now rides the native path — stays oracle-consistent
+    assert bls.verify(sk0.to_public_key(), msg, sk0.sign(msg))
+    assert not bls.verify(sk0.to_public_key(), msg, sk1.sign(msg))

@@ -1,0 +1,91 @@
+"""Virtual-mesh scaling table for the sharded grouped verifier.
+
+Runs the SAME total batch (64 root-rows × 64 lanes = 4096 sets) on
+1/2/4/8-device virtual CPU meshes and records steady-state sets/s plus
+verdict parity with the single-device kernel (VERDICT r2 next-step #7).
+CPU-mesh numbers measure the SHARDING (collective layout, per-chip graph),
+not TPU silicon — the table's point is that the ICI tier composes and
+scales, with real-chip numbers to follow on multi-chip hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lodestar_tpu.utils.jax_env import force_platform
+
+N_MAX = int(os.environ.get("MESH_MAX", "8"))
+force_platform("cpu", N_MAX)
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"),
+)
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def main():
+    from __graft_entry__ import _example_grouped
+    from lodestar_tpu.parallel.sharded import ShardedGroupedVerifier
+    from lodestar_tpu.parallel.verifier import BatchVerifier
+
+    rows, lanes = 64, 64
+    g, a_bits, b_bits = _example_grouped(rows, lanes)
+    table = []
+
+    # single-device reference verdict (the unsharded kernel)
+    bv = BatchVerifier(grouped_configs=((rows, lanes),))
+    t0 = time.monotonic()
+    ref = bool(bv.verify_grouped(g, a_bits, b_bits))
+    compile_1 = time.monotonic() - t0
+    t0 = time.monotonic()
+    reps = 2
+    for _ in range(reps):
+        out = bv.verify_grouped(g, a_bits, b_bits)
+    jax.block_until_ready(out)
+    dt = (time.monotonic() - t0) / reps
+    table.append(
+        {"devices": 1, "sets_per_sec": round(rows * lanes / dt, 1),
+         "verdict": ref, "compile_s": round(compile_1, 1)}
+    )
+    print(table[-1], flush=True)
+    assert ref, "reference verdict False on a valid batch"
+
+    sizes = [n for n in (2, 4, 8) if n <= N_MAX]
+    for n in sizes:
+        mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("dp",))
+        v = ShardedGroupedVerifier(mesh)
+        t0 = time.monotonic()
+        ok = v.verify_grouped(g, a_bits, b_bits)
+        compile_s = time.monotonic() - t0
+        assert ok == ref, f"verdict parity broken at {n} devices"
+        t0 = time.monotonic()
+        for _ in range(reps):
+            ok = v.verify_grouped(g, a_bits, b_bits)
+        dt = (time.monotonic() - t0) / reps
+        table.append(
+            {"devices": n, "sets_per_sec": round(rows * lanes / dt, 1),
+             "verdict": bool(ok), "compile_s": round(compile_s, 1)}
+        )
+        print(table[-1], flush=True)
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "MESH_SCALING.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump({"shape": f"{rows}x{lanes}", "platform": "cpu-virtual",
+                   "table": table}, f, indent=2)
+    print(json.dumps(table))
+
+
+if __name__ == "__main__":
+    main()
