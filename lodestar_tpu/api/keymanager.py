@@ -136,8 +136,29 @@ class KeymanagerApiImpl:
 
 
 def create_keymanager_server(store, host: str = "127.0.0.1", port: int = 0,
-                             signer_factory=None):
+                             signer_factory=None, bearer_token: str | None = None,
+                             token_file: str | None = None):
+    """Keymanager REST server. The reference REQUIRES bearer auth here
+    (`api/rest/index.ts` keymanager registration): if no token is given,
+    one is generated; `token_file` persists it (reference writes
+    `api-token.txt` under the datadir) so operators can find it."""
     from .server import BeaconApiServer
 
+    if bearer_token is None:
+        import secrets as _secrets
+
+        bearer_token = "api-token-0x" + _secrets.token_hex(16)
+    if token_file is not None:
+        import os
+
+        # owner-only from creation — no world-readable window
+        fd = os.open(token_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(bearer_token + "\n")
     impl = KeymanagerApiImpl(store, signer_factory)
-    return BeaconApiServer(impl, host=host, port=port, matcher=match_keymanager_route)
+    server = BeaconApiServer(
+        impl, host=host, port=port, matcher=match_keymanager_route,
+        bearer_token=bearer_token,
+    )
+    server.bearer_token = bearer_token
+    return server

@@ -19,14 +19,26 @@ from .routes import match_route
 class BeaconApiServer:
     def __init__(
         self, impl, host: str = "127.0.0.1", port: int = 0, matcher=None,
-        metrics=None,
+        metrics=None, bearer_token: str | None = None,
+        cors_origin: str | None = None,
     ):
         """`matcher(method, path) -> (route, params)`: defaults to the
-        beacon route table; the keymanager server passes its own."""
+        beacon route table; the keymanager server passes its own.
+
+        `bearer_token`: when set, every request must carry
+        `Authorization: Bearer <token>` or is refused with 401 — the
+        reference's fastify bearer-auth plugin (`api/rest/index.ts:52-58`,
+        keymanager server requires it; beacon server opt-in).
+        `cors_origin`: when set, responses carry CORS headers for that
+        origin (`*` allowed) and OPTIONS preflights are answered —
+        the reference's fastify-cors registration (`api/rest/index.ts:47-50`).
+        """
         self.impl = impl
         impl_ref = impl
         match = matcher if matcher is not None else match_route
         metrics_ref = metrics
+        token_ref = bearer_token
+        cors_ref = cors_origin
 
         def _observe(path: str, status: int, seconds: float) -> None:
             if metrics_ref is None:
@@ -43,7 +55,26 @@ class BeaconApiServer:
             def log_message(self, fmt, *args):  # quiet
                 pass
 
+            def _authorized(self) -> bool:
+                if token_ref is None:
+                    return True
+                import hmac
+
+                header = self.headers.get("Authorization", "")
+                # constant-time compare (the reference's fastify
+                # bearer-auth does the same) — no timing oracle on the
+                # token; bytes (not str) because compare_digest raises on
+                # non-ASCII str and headers arrive latin-1-decoded
+                return hmac.compare_digest(
+                    header.encode("latin-1", "replace"),
+                    f"Bearer {token_ref}".encode(),
+                )
+
             def _handle(self, method: str):
+                if not self._authorized():
+                    return self._send(
+                        401, {"message": "missing or invalid bearer token"}
+                    )
                 parsed = urlparse(self.path)
                 if method == "GET" and parsed.path == "/eth/v1/events":
                     return self._handle_events(parsed)
@@ -108,6 +139,7 @@ class BeaconApiServer:
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
+                    self._cors_headers()
                     self.end_headers()
                     while True:
                         try:
@@ -127,6 +159,12 @@ class BeaconApiServer:
                     for e in ChainEvent:
                         emitter.off(e, on_event)
 
+            def _cors_headers(self):
+                if cors_ref is not None:
+                    self.send_header("Access-Control-Allow-Origin", cors_ref)
+                    if cors_ref != "*":
+                        self.send_header("Vary", "Origin")
+
             def _send(self, status: int, obj):
                 import time as _t
 
@@ -138,6 +176,7 @@ class BeaconApiServer:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
+                self._cors_headers()
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -158,6 +197,19 @@ class BeaconApiServer:
 
                 self._t0 = _t.monotonic()
                 self._handle("DELETE")
+
+            def do_OPTIONS(self):
+                # CORS preflight: no auth (browsers send it tokenless)
+                self.send_response(204)
+                self._cors_headers()
+                self.send_header(
+                    "Access-Control-Allow-Methods", "GET, POST, DELETE, OPTIONS"
+                )
+                self.send_header(
+                    "Access-Control-Allow-Headers", "Content-Type, Authorization"
+                )
+                self.send_header("Access-Control-Max-Age", "86400")
+                self.end_headers()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None

@@ -22,6 +22,23 @@ from ..types import get_types
 from ..utils.logger import get_logger
 
 
+
+def _read_token_file(path: str | None) -> str | None:
+    """Bearer token from a file (reference: api/rest bearer-auth token file);
+    whitespace-stripped, None when unset. A missing or empty file is a
+    configuration error — refusing loudly beats serving with a
+    zero-entropy token or rejecting every client."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            token = f.read().strip()
+    except OSError as e:
+        raise SystemExit(f"--rest-auth-token-file: cannot read {path}: {e}")
+    if not token:
+        raise SystemExit(f"--rest-auth-token-file: {path} is empty")
+    return token
+
 def _fetch_checkpoint_state(url: str) -> tuple[str, bytes]:
     """(fork_name, ssz_bytes) of a finalized state over the debug SSZ route
     (reference: fetchWeakSubjectivityState from --checkpointSyncUrl)."""
@@ -132,6 +149,8 @@ def run_beacon(args) -> int:
             db_controller=db_controller,  # datadir-backed, persists restarts
             rest=args.rest,
             rest_port=args.rest_port,
+            rest_bearer_token=_read_token_file(args.rest_auth_token_file),
+            rest_cors_origin=args.rest_cors,
             metrics=args.metrics,
             metrics_port=args.metrics_port,
             tpu_verifier=args.tpu_verifier,
@@ -378,6 +397,14 @@ def add_beacon_parser(sub) -> None:
     p.add_argument("--genesis-time", type=int, default=0)
     p.add_argument("--rest", action="store_true")
     p.add_argument("--rest-port", type=int, default=5052)
+    p.add_argument(
+        "--rest-auth-token-file",
+        help="file holding the bearer token required on every REST request",
+    )
+    p.add_argument(
+        "--rest-cors",
+        help='CORS allowed origin for the REST API (e.g. "*")',
+    )
     p.add_argument("--metrics", action="store_true")
     p.add_argument("--metrics-port", type=int, default=8008)
     p.add_argument("--execution", default=None, help='"mock" or host:port of an EL engine API')

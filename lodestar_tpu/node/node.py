@@ -30,6 +30,8 @@ class NodeOptions:
     db_controller: object | None = None  # pre-opened controller wins over datadir
     rest: bool = True
     rest_port: int = 0
+    rest_bearer_token: str | None = None  # require Authorization: Bearer …
+    rest_cors_origin: str | None = None  # Access-Control-Allow-Origin value
     metrics: bool = False
     metrics_port: int = 0
     tpu_verifier: bool = False
@@ -116,7 +118,9 @@ class BeaconNode:
         if opts.rest:
             impl = BeaconApiImpl(config, types, self.chain)
             self.api_server = BeaconApiServer(
-                impl, port=opts.rest_port, metrics=self.metrics
+                impl, port=opts.rest_port, metrics=self.metrics,
+                bearer_token=opts.rest_bearer_token,
+                cors_origin=opts.rest_cors_origin,
             )
             self.api_server.start()
             self.log.info("REST API on :%d", self.api_server.port)

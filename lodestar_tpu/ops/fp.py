@@ -298,6 +298,10 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """
     import os
 
+    if os.environ.get("LODESTAR_TPU_PALLAS_MXU") == "1":
+        from .pallas_mxu import mont_mul
+
+        return mont_mul(a, b)
     if os.environ.get("LODESTAR_TPU_PALLAS_MUL") == "1":
         from .pallas_fp import mont_mul
 

@@ -193,3 +193,50 @@ def test_event_stream_sse(api_env):
             break
         names.add(name)
     assert "block" in names or "head" in names, f"no events received: {names}"
+
+
+def test_bearer_auth_and_cors(api_env):
+    """Reference parity: fastify bearer-auth + cors registration
+    (`beacon-node/src/api/rest/index.ts:47-60`)."""
+    import http.client
+
+    config, types, chain, service, _ = api_env
+    impl = BeaconApiImpl(config, types, chain, validator_service=service)
+    server = BeaconApiServer(
+        impl, port=0, bearer_token="s3cret", cors_origin="https://ui.example"
+    )
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        # no token → 401
+        conn.request("GET", "/eth/v1/beacon/genesis")
+        resp = conn.getresponse()
+        assert resp.status == 401
+        resp.read()
+        # wrong token → 401
+        conn.request(
+            "GET", "/eth/v1/beacon/genesis",
+            headers={"Authorization": "Bearer nope"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 401
+        resp.read()
+        # right token → 200, with CORS header
+        conn.request(
+            "GET", "/eth/v1/beacon/genesis",
+            headers={"Authorization": "Bearer s3cret"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Access-Control-Allow-Origin") == "https://ui.example"
+        resp.read()
+        # preflight needs no token and advertises methods
+        conn.request("OPTIONS", "/eth/v1/beacon/genesis")
+        resp = conn.getresponse()
+        assert resp.status == 204
+        assert "POST" in resp.getheader("Access-Control-Allow-Methods", "")
+        assert resp.getheader("Access-Control-Allow-Origin") == "https://ui.example"
+        resp.read()
+        conn.close()
+    finally:
+        server.close()

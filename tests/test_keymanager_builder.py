@@ -17,16 +17,16 @@ from lodestar_tpu.validator import SlashingProtection, ValidatorStore
 from lodestar_tpu.validator.keystore import encrypt_keystore
 
 
-def _km_request(port, method, path, body=None):
+def _km_request(port, method, path, body=None, token=None):
     import http.client
 
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
     try:
         payload = json.dumps(body).encode() if body is not None else None
-        conn.request(
-            method, path, body=payload,
-            headers={"Content-Type": "application/json"} if payload else {},
-        )
+        headers = {"Content-Type": "application/json"} if payload else {}
+        if token is not None:
+            headers["Authorization"] = f"Bearer {token}"
+        conn.request(method, path, body=payload, headers=headers)
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read() or b"{}")
     finally:
@@ -50,25 +50,31 @@ def test_keymanager_import_list_delete(km_env):
 
     status, out = _km_request(
         server.port, "POST", "/eth/v1/keystores",
-        {"keystores": [json.dumps(ks)], "passwords": ["pw"]},
+        {"keystores": [json.dumps(ks)], "passwords": ["pw"]}, token=server.bearer_token,
     )
     assert status == 200
     assert out["data"][0]["status"] == "imported"
     pk_hex = "0x" + sk.to_public_key().to_bytes().hex()
 
-    status, out = _km_request(server.port, "GET", "/eth/v1/keystores")
+    status, out = _km_request(server.port, "GET", "/eth/v1/keystores", token=server.bearer_token)
     assert [k["validating_pubkey"] for k in out["data"]] == [pk_hex]
 
     # duplicate import reported as duplicate
     status, out = _km_request(
         server.port, "POST", "/eth/v1/keystores",
-        {"keystores": [json.dumps(ks)], "passwords": ["pw"]},
+        {"keystores": [json.dumps(ks)], "passwords": ["pw"]}, token=server.bearer_token,
     )
     assert out["data"][0]["status"] == "duplicate"
 
     # delete returns slashing interchange
-    status, out = _km_request(
+    # no token -> 401 (reference: keymanager API requires bearer auth)
+    status, _ = _km_request(
         server.port, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]}
+    )
+    assert status == 401
+    status, out = _km_request(
+        server.port, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]},
+        token=server.bearer_token,
     )
     assert out["data"]["statuses"][0]["status"] == "deleted"
     assert out["data"]["slashing_protection"]["metadata"]["interchange_format_version"] == "5"
@@ -81,7 +87,7 @@ def test_keymanager_wrong_password(km_env):
     ks = encrypt_keystore(sk.value.to_bytes(32, "big"), "pw")
     _, out = _km_request(
         server.port, "POST", "/eth/v1/keystores",
-        {"keystores": [json.dumps(ks)], "passwords": ["nope"]},
+        {"keystores": [json.dumps(ks)], "passwords": ["nope"]}, token=server.bearer_token,
     )
     assert out["data"][0]["status"] == "error"
 

@@ -59,6 +59,124 @@ class TestSlashingProtection:
         for e in range(1, 10):
             protection.check_and_insert_attestation(PK, e, e + 1, bytes([e]) * 32)
 
+    def test_min_max_surround_across_pruned_history(self, protection):
+        """VERDICT r3 Missing #4: surround detection must survive the
+        512-target exact-root prune — the min/max distance spans answer
+        for votes whose targets are long gone (reference:
+        minMaxSurround.ts)."""
+        # vote (10, 11), then 600 adjacent votes pushing it out of the
+        # exact-root window
+        protection.check_and_insert_attestation(PK, 10, 11, b"\x01" * 32)
+        for e in range(12, 612):
+            protection.check_and_insert_attestation(PK, e - 1, e, bytes([e % 256]) * 32)
+        rec = protection.atts.get(PK)
+        assert str(11) not in rec["targets"], "test needs (10,11) pruned"
+        # surrounding the pruned vote: s=9 < 10, t=700 > 11
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 9, 700, b"\x02" * 32)
+
+    def test_surrounded_across_pruned_history(self, protection):
+        # wide vote (100, 640), then many adjacent votes to prune it
+        protection.check_and_insert_attestation(PK, 100, 640, b"\x01" * 32)
+        for e in range(641, 1400):
+            protection.check_and_insert_attestation(PK, e - 1, e, bytes([e % 256]) * 32)
+        rec = protection.atts.get(PK)
+        assert str(640) not in rec["targets"]
+        # surrounded by the pruned wide vote: 100 < 200, 300 < 640
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 200, 300, b"\x02" * 32)
+
+    def test_double_vote_below_retained_window_refused(self, protection):
+        for e in range(1, 600):
+            protection.check_and_insert_attestation(PK, e - 1, e, bytes([e % 256]) * 32)
+        rec = protection.atts.get(PK)
+        pruned_below = rec["pruned_below"]
+        assert pruned_below > 0
+        # a target inside the pruned region cannot be double-vote-checked
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(
+                PK, 0, pruned_below, b"\xfe" * 32
+            )
+
+    def test_source_below_span_floor_refused(self):
+        p = SlashingProtection(MemoryDb(), max_epoch_lookback=64)
+        p.check_and_insert_attestation(PK, 500, 501, b"\x01" * 32)
+        # floor advanced to 501 - 64 = 437; unknown deep history refused
+        with pytest.raises(SlashingError):
+            p.check_and_insert_attestation(PK, 100, 502, b"\x02" * 32)
+
+    def test_wide_vote_beyond_lookback_detected(self):
+        """A vote wider than the span lookback cannot ride the bounded
+        walks — it must land on the wide list and still bite."""
+        p = SlashingProtection(MemoryDb(), max_epoch_lookback=64)
+        p.check_and_insert_attestation(PK, 100, 1000, b"\x01" * 32)  # wide
+        # surrounded by the wide vote, source far beyond the walk bound
+        with pytest.raises(SlashingError):
+            p.check_and_insert_attestation(PK, 500, 600, b"\x02" * 32)
+        # surrounding the wide vote
+        with pytest.raises(SlashingError):
+            p.check_and_insert_attestation(PK, 99, 1001, b"\x03" * 32)
+
+    def test_old_format_record_migrates_to_spans(self, protection):
+        """Pre-span records (targets only) must regain surround protection
+        via the one-time replay migration, not silently lose it."""
+        # simulate an old-format record: targets dict without span keys
+        protection.atts.put(
+            PK,
+            {
+                "targets": {
+                    "60": {"source": 50, "root": "aa" * 32},
+                    "61": {"source": 60, "root": "bb" * 32},
+                },
+                "max_target": 61,
+                "min_source": 50,
+            },
+        )
+        # surrounding vote of the old (50, 60) must still be refused
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 40, 100, b"\x02" * 32)
+        # double vote at a migrated target keeps its root
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 50, 60, b"\x0c" * 32)
+        protection.check_and_insert_attestation(PK, 50, 60, b"\xaa" * 32)
+        # votes below the migration floor are refused, not guessed at
+        with pytest.raises(SlashingError):
+            protection.check_and_insert_attestation(PK, 30, 45, b"\x03" * 32)
+        # normal progression continues
+        protection.check_and_insert_attestation(PK, 61, 62, b"\x04" * 32)
+
+    def test_span_property_random(self, protection):
+        """Property test: the span answers must equal the brute-force
+        surround scan over the FULL vote history (never pruned here)."""
+        import random
+
+        rng = random.Random(1234)
+        accepted: list[tuple[int, int]] = []
+        used_targets: dict[int, int] = {}
+        for i in range(400):
+            s = rng.randrange(0, 256)
+            t = s + rng.randrange(1, 40)
+            brute_reject = any(
+                (s < s2 and t > t2) or (s > s2 and t < t2)
+                for s2, t2 in accepted
+            )
+            if t in used_targets:
+                brute_reject = brute_reject or used_targets[t] != i % 7
+            root = bytes([i % 7]) * 32
+            try:
+                protection.check_and_insert_attestation(PK, s, t, root)
+                ok = True
+            except SlashingError:
+                ok = False
+            if t in used_targets:
+                # double-vote path: accepted iff same root
+                assert ok == (used_targets[t] == i % 7), (i, s, t)
+            else:
+                assert ok == (not brute_reject), (i, s, t, accepted)
+            if ok and t not in used_targets:
+                accepted.append((s, t))
+                used_targets[t] = i % 7
+
     def test_interchange_roundtrip(self, protection):
         protection.check_and_insert_block_proposal(PK, 7, b"\x0b" * 32)
         protection.check_and_insert_attestation(PK, 1, 2, b"\x0a" * 32)

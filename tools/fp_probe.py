@@ -25,6 +25,8 @@ elif mode == "mxu":
     os.environ["LODESTAR_TPU_MXU_MUL"] = "1"
 elif mode == "pallas":
     os.environ["LODESTAR_TPU_PALLAS_MUL"] = "1"
+elif mode == "mxu2":
+    os.environ["LODESTAR_TPU_PALLAS_MXU"] = "1"
 
 from lodestar_tpu.ops import fp  # noqa: E402
 

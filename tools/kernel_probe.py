@@ -14,6 +14,8 @@ if mode == "scan":
     os.environ["LODESTAR_TPU_LEGACY_FP"] = "1"
 elif mode == "mxu":
     os.environ["LODESTAR_TPU_MXU_MUL"] = "1"
+elif mode == "mxu2":
+    os.environ["LODESTAR_TPU_PALLAS_MXU"] = "1"
 
 import jax
 
