@@ -197,35 +197,153 @@ class BufferedVerifier:
         if not buffer:
             return
         now = time.monotonic()
-        merged: list[bls.SignatureSet] = []
-        for sets, _, enq in buffer:
-            merged.extend(sets)
-            if self.prom is not None:
+        if self.prom is not None:
+            for _, _, enq in buffer:
                 self.prom.bls_buffer_wait_seconds.observe(now - enq)
-        self.metrics["batches"] += 1
-        self.metrics["sigs_verified"] += len(merged)
-        if self.prom is not None:
-            self.prom.bls_buffer_depth.set(0)
-            self.prom.bls_job_sets.observe(len(merged))
-            self.prom.bls_batches_total.inc()
-            self.prom.bls_sets_total.inc(len(merged))
-        ok = self.verifier.verify_signature_sets(merged)
-        if ok:
-            for _, fut, _ in buffer:
-                if not fut.done():
-                    fut.set_result(True)
-            return
-        # batch failed: resolve per-request from one individual pass
-        self.metrics["batch_fallbacks"] += 1
-        if self.prom is not None:
-            self.prom.bls_batch_fallbacks_total.inc()
-        verdicts = self.verifier.verify_signature_sets_individual(merged)
-        pos = 0
-        for sets, fut, _ in buffer:
-            share = verdicts[pos : pos + len(sets)]
-            pos += len(sets)
+        try:
+            per_request = _verify_merged(
+                self.verifier, [b[0] for b in buffer], self.metrics, self.prom
+            )
+        except Exception as e:  # resolve waiters rather than hang them
+            per_request = [False] * len(buffer)
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").error(
+                "buffered batch verification failed (%s); resolving %d "
+                "requests as invalid", e, len(buffer),
+            )
+        for (_, fut, _), verdict in zip(buffer, per_request):
             if not fut.done():
-                fut.set_result(all(share))
+                fut.set_result(verdict)
+
+
+def _verify_merged(verifier, set_groups, metrics, prom) -> list[bool]:
+    """Merge request groups into one batch verification with the per-set
+    fallback, updating the shared metrics families; returns one verdict
+    per GROUP. The single copy of the batching semantics behind both the
+    asyncio and the thread facade (reference: multithread/index.ts
+    job merge + worker.ts retry-individually)."""
+    merged: list = []
+    for sets in set_groups:
+        merged.extend(sets)
+    metrics["batches"] += 1
+    metrics["sigs_verified"] += len(merged)
+    if prom is not None:
+        prom.bls_buffer_depth.set(0)
+        prom.bls_job_sets.observe(len(merged))
+        prom.bls_batches_total.inc()
+        prom.bls_sets_total.inc(len(merged))
+    if verifier.verify_signature_sets(merged):
+        return [True] * len(set_groups)
+    metrics["batch_fallbacks"] += 1
+    if prom is not None:
+        prom.bls_batch_fallbacks_total.inc()
+    verdicts = verifier.verify_signature_sets_individual(merged)
+    out = []
+    pos = 0
+    for sets in set_groups:
+        share = verdicts[pos : pos + len(sets)]
+        pos += len(sets)
+        out.append(all(share))
+    return out
+
+
+class ThreadBufferedVerifier:
+    """Sync IBlsVerifier facade merging CONCURRENT verify calls into
+    device batches.
+
+    The gossip validation queues run their ladders on executor threads
+    (`gossip/handlers._process`), each verifying one object's signature
+    set synchronously — without merging, every attestation would be its
+    own device dispatch. This facade buffers calls across threads up to
+    MAX_BUFFERED_SIGS or MAX_BUFFER_WAIT_MS and verifies them as ONE
+    batch, falling back to per-set verdicts when the batch fails — the
+    thread-world twin of `BufferedVerifier` (reference semantics:
+    `multithread/index.ts:39-57`, worker threads enqueue into pool jobs).
+    Single-caller workloads degrade gracefully: the wait-window timer
+    flushes them at the deadline."""
+
+    def __init__(self, verifier: IBlsVerifier, max_sigs: int = MAX_BUFFERED_SIGS,
+                 max_wait_ms: float = MAX_BUFFER_WAIT_MS, prom=None):
+        import threading
+
+        self.verifier = verifier
+        self.max_sigs = max_sigs
+        self.max_wait = max_wait_ms / 1000.0
+        self.prom = prom
+        self._lock = threading.Lock()
+        self._entries: list[tuple[list, object, list]] = []
+        self._timer: object | None = None
+        self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
+
+    # non-batchable path parity: chain code that must not wait calls this
+    def verify_signature_sets_individual(self, sets):
+        return self.verifier.verify_signature_sets_individual(sets)
+
+    def verify_signature_sets(self, sets, batchable: bool = True) -> bool:
+        import threading
+
+        sets = list(sets)
+        if not sets:
+            return False
+        # latency-critical callers (block import) and calls already at
+        # batch size skip the wait window entirely — the async facade's
+        # batchable=False contract (reference: verifySignatureSets opts)
+        if not batchable or len(sets) >= self.max_sigs:
+            return self.verifier.verify_signature_sets(sets)
+        ev = threading.Event()
+        holder: list = [None]
+        flush_now = None
+        with self._lock:
+            self._entries.append((sets, ev, holder))
+            buffered = sum(len(e[0]) for e in self._entries)
+            if self.prom is not None:
+                self.prom.bls_buffer_depth.set(buffered)
+            if buffered >= self.max_sigs:
+                flush_now = self._take_locked()
+            elif self._timer is None:
+                self._timer = threading.Timer(self.max_wait, self._flush_timed)
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now is not None:
+            self._run_batch(flush_now)
+        ev.wait()
+        return holder[0]
+
+    def _take_locked(self):
+        entries, self._entries = self._entries, []
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return entries
+
+    def _flush_timed(self):
+        with self._lock:
+            self._timer = None
+            entries = self._take_locked()
+        if entries:
+            self._run_batch(entries)
+
+    def _run_batch(self, entries) -> None:
+        """Verify a merged batch and resolve every entry — ALWAYS: an
+        exception here (device OOM, preemption) must resolve waiters as
+        False rather than deadlock every blocked gossip/import thread
+        (they hold no timeout on their Event)."""
+        try:
+            per_request = _verify_merged(
+                self.verifier, [e[0] for e in entries], self.metrics, self.prom
+            )
+        except Exception:
+            per_request = [False] * len(entries)
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").exception(
+                "buffered batch verification failed; resolving %d requests "
+                "as invalid", len(entries),
+            )
+        for (_, ev, holder), verdict in zip(entries, per_request):
+            holder[0] = verdict
+            ev.set()
 
 
 class MockBlsVerifier:

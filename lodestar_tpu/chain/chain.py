@@ -47,6 +47,17 @@ from .seen_cache import (
 from .state_cache import CheckpointStateCache, StateContextCache
 
 
+
+def _verify_now(verifier, sets) -> bool:
+    """verify_signature_sets with batchable=False where the facade
+    supports it (block/segment import must not wait out a gossip
+    batching window)."""
+    try:
+        return verifier.verify_signature_sets(sets, batchable=False)
+    except TypeError:
+        return verifier.verify_signature_sets(sets)
+
+
 class BlockImportError(ValueError):
     pass
 
@@ -220,7 +231,11 @@ class BeaconChain:
         t_start = _time.monotonic()
         if verify_signatures:
             sets = get_block_signature_sets(pre, self.types, signed_block)
-            fut_sig = self._verify_pool.submit(self.bls.verify_signature_sets, sets)
+            # block import is latency-critical: verify immediately rather
+            # than sitting in a batching facade's wait window
+            fut_sig = self._verify_pool.submit(
+                _verify_now, self.bls, sets
+            )
         fut_payload = self._verify_pool.submit(
             self._verify_execution_payload, pre, signed_block
         )
@@ -334,7 +349,7 @@ class BeaconChain:
         try:
             if verify_signatures and all_sets:
                 t0 = _time.monotonic()
-                if not self.bls.verify_signature_sets(all_sets):
+                if not _verify_now(self.bls, all_sets):
                     if m is not None:
                         m.block_import_errors_total.inc(reason="signature")
                     raise BlockImportError("segment signature batch failed")

@@ -253,7 +253,7 @@ def bls_g1_aggregate(pks: bytes, check_each: bool = True):
 def bls_marshal_sets(pks: bytes, msgs: bytes, sigs: bytes, dst: bytes,
                      check_pk_subgroup: bool = False,
                      check_sig_subgroup: bool = True,
-                     do_hash: bool = True):
+                     do_hash: bool = True, do_pk: bool = True):
     """Batch-marshal n signature sets straight into device arrays.
 
     pks n×48B, msgs n×32B signing roots, sigs n×96B →
@@ -267,12 +267,15 @@ def bls_marshal_sets(pks: bytes, msgs: bytes, sigs: bytes, dst: bytes,
     do_hash=False skips the per-set hash-to-curve (msg arrays stay zero)
     so callers can fill them from a cache — committee gossip shares
     signing roots, making per-set hashing mostly redundant.
+    do_pk=False likewise skips pubkey decompression (pk arrays stay
+    zero) for callers holding a pubkey-limb cache — attesters repeat
+    across epochs, the reference's pubkey cache exists for this reason.
     """
     import numpy as np
 
     buf, ok = _mod.bls_marshal_sets(
         pks, msgs, sigs, dst, int(check_pk_subgroup), int(check_sig_subgroup),
-        int(do_hash),
+        int(do_hash), int(do_pk),
     )
     n = len(ok)
     a = np.frombuffer(buf, np.int32)

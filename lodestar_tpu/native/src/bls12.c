@@ -1532,20 +1532,30 @@ int lodestar_bls_g1_aggregate(const uint8_t *pks, size_t n, int check_each,
 int lodestar_bls_marshal_sets(size_t n, const uint8_t *pks, const uint8_t *msgs,
                               const uint8_t *sigs, const uint8_t *dst,
                               size_t dst_len, int check_pk_subgroup,
-                              int check_sig_subgroup, int do_hash,
+                              int check_sig_subgroup, int do_hash, int do_pk,
                               int32_t *pk_x, int32_t *pk_y, int32_t *msg_x,
                               int32_t *msg_y, int32_t *sig_x, int32_t *sig_y,
                               uint8_t *ok) {
   /* do_hash=0: caller fills msg_x/msg_y itself (e.g. from a
    * hash-to-curve cache — gossip shares signing roots across a whole
-   * committee, so per-set hashing is mostly redundant work). */
+   * committee, so per-set hashing is mostly redundant work).
+   * do_pk=0: caller fills pk_x/pk_y from its pubkey-limb cache (the
+   * reference's pubkey cache deserializes each validator key once —
+   * attesters repeat every epoch, so the per-set G1 sqrt is redundant
+   * steady-state work). */
+  if (!do_pk) {
+    memset(pk_x, 0, n * 32 * sizeof(int32_t));
+    memset(pk_y, 0, n * 32 * sizeof(int32_t));
+  }
   for (size_t i = 0; i < n; i++) {
     ok[i] = 0;
-    int rc = lodestar_bls_g1_decompress(pks + 48 * i, pk_x + 32 * i,
-                                        pk_y + 32 * i, check_pk_subgroup);
-    if (rc != 0) continue; /* infinity pubkey is invalid per Eth2 */
-    rc = lodestar_bls_g2_decompress(sigs + 96 * i, sig_x + 64 * i,
-                                    sig_y + 64 * i, check_sig_subgroup);
+    if (do_pk) {
+      int rcp = lodestar_bls_g1_decompress(pks + 48 * i, pk_x + 32 * i,
+                                           pk_y + 32 * i, check_pk_subgroup);
+      if (rcp != 0) continue; /* infinity pubkey is invalid per Eth2 */
+    }
+    int rc = lodestar_bls_g2_decompress(sigs + 96 * i, sig_x + 64 * i,
+                                        sig_y + 64 * i, check_sig_subgroup);
     if (rc != 0) continue;
     if (do_hash) {
       rc = lodestar_bls_hash_to_g2(msgs + 32 * i, 32, dst, dst_len,

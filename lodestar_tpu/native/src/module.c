@@ -144,7 +144,7 @@ int lodestar_bls_g1_aggregate(const uint8_t *pks, size_t n, int check_each,
 int lodestar_bls_marshal_sets(size_t n, const uint8_t *pks, const uint8_t *msgs,
                               const uint8_t *sigs, const uint8_t *dst,
                               size_t dst_len, int check_pk_subgroup,
-                              int check_sig_subgroup, int do_hash,
+                              int check_sig_subgroup, int do_hash, int do_pk,
                               int32_t *pk_x, int32_t *pk_y, int32_t *msg_x,
                               int32_t *msg_y, int32_t *sig_x, int32_t *sig_y,
                               uint8_t *ok);
@@ -231,9 +231,9 @@ static PyObject *py_bls_g1_aggregate(PyObject *self, PyObject *args) {
 
 static PyObject *py_bls_marshal_sets(PyObject *self, PyObject *args) {
   Py_buffer pks, msgs, sigs, dst;
-  int check_pk = 0, check_sig = 1, do_hash = 1;
-  if (!PyArg_ParseTuple(args, "y*y*y*y*|iii", &pks, &msgs, &sigs, &dst,
-                        &check_pk, &check_sig, &do_hash))
+  int check_pk = 0, check_sig = 1, do_hash = 1, do_pk = 1;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*|iiii", &pks, &msgs, &sigs, &dst,
+                        &check_pk, &check_sig, &do_hash, &do_pk))
     return NULL;
   Py_ssize_t n = pks.len / 48;
   PyObject *out = NULL, *ok = NULL;
@@ -258,8 +258,8 @@ static PyObject *py_bls_marshal_sets(PyObject *self, PyObject *args) {
                               (const uint8_t *)msgs.buf,
                               (const uint8_t *)sigs.buf,
                               (const uint8_t *)dst.buf, (size_t)dst.len,
-                              check_pk, check_sig, do_hash, pk_x, pk_y,
-                              msg_x, msg_y, sig_x, sig_y, okp);
+                              check_pk, check_sig, do_hash, do_pk, pk_x,
+                              pk_y, msg_x, msg_y, sig_x, sig_y, okp);
     Py_END_ALLOW_THREADS
   }
 done:

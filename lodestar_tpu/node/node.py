@@ -76,11 +76,16 @@ class BeaconNode:
 
         install_gc_metrics(self.metrics.registry)
 
-        # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread)
+        # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread);
+        # the device tier sits behind the cross-thread batching facade so
+        # concurrent gossip-queue validations merge into device batches
         if opts.tpu_verifier:
-            from ..chain.bls_verifier import DeviceBlsVerifier
+            from ..chain.bls_verifier import (
+                DeviceBlsVerifier,
+                ThreadBufferedVerifier,
+            )
 
-            verifier = DeviceBlsVerifier()
+            verifier = ThreadBufferedVerifier(DeviceBlsVerifier())
         else:
             verifier = CpuBlsVerifier()
         self.chain = BeaconChain(

@@ -211,6 +211,115 @@ def conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return c[0] + (c[1] << 8) + (c[2] << 16)
 
 
+# --- column-space (lazy-reduction) pipeline ---------------------------------
+#
+# Products as 64 uncarried int32 columns let tower code ADD/SUBTRACT whole
+# products before reducing: Fp2 Karatsuba becomes 3 convolutions + 2 REDCs
+# instead of 3 full Montgomery multiplies (the classic lazy-reduction
+# optimization blst applies to the same tower). Column bounds: one product
+# of canonical-limb inputs stays < 2^29; up to 3 products (plus a constant
+# offset) fit signed int32.
+
+
+def conv_cols(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook convolution → (..., 2N) int32 columns, as 32 STATIC
+    shifted multiply-adds (no dynamic slicing, no matmul blowup — fuses
+    into wide VPU code)."""
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (N_LIMBS,))
+    b = jnp.broadcast_to(b, batch + (N_LIMBS,))
+    pad = [(0, 0)] * len(batch)
+    t = jnp.zeros(batch + (2 * N_LIMBS,), jnp.int32)
+    for i in range(N_LIMBS):
+        t = t + jnp.pad(
+            a[..., i : i + 1] * b, pad + [(i, N_LIMBS - i)]
+        )
+    return t
+
+
+def _conv_cols_mod_r(a: jnp.ndarray, const: jnp.ndarray) -> jnp.ndarray:
+    """Truncated convolution (columns 0..N-1 only) with a constant
+    operand — the `m = t·N' mod R` step of full-width REDC."""
+    t = jnp.zeros(a.shape, jnp.int32)
+    pad = [(0, 0)] * (a.ndim - 1)
+    for i in range(N_LIMBS):
+        seg = a[..., i : i + 1] * const[: N_LIMBS - i]
+        t = t + jnp.pad(seg, pad + [(i, 0)])
+    return t
+
+
+def redc_cols(t_cols: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery-reduce signed columns → canonical limbs in [0, 2p).
+
+    `t_cols` (..., 2N) int32 columns of a NON-NEGATIVE value < 12p²
+    (columns may be negative). GRAPH-LIGHT: the reduction is the proven
+    word-serial `lax.scan` applied DIRECTLY to the signed columns — only
+    the low 12 bits of a column feed the m-digit, and arithmetic shifts
+    ripple negative carries, so no prior normalization is needed (≈12
+    jaxpr eqns total). The full-width m/u-convolution form costs ~200
+    eqns per site and blew kernel compiles past 50 min (the round-2
+    compile-size lesson, relearned on the lazy tower; `redc_cols_conv`
+    keeps that form for experiments)."""
+    t = t_cols
+
+    def redc_step(acc, i):
+        chunk = lax.dynamic_slice_in_dim(acc, i, N_LIMBS, axis=-1)
+        m = (chunk[..., 0:1] * N0) & LIMB_MASK
+        chunk = chunk + m * _P
+        carry = chunk[..., 0:1] >> LIMB_BITS
+        chunk = chunk.at[..., 1:2].add(carry)
+        chunk = chunk.at[..., 0:1].set(0)
+        return lax.dynamic_update_slice_in_dim(acc, chunk, i, axis=-1), None
+
+    t, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
+    out = carry_scan(t[..., N_LIMBS:])
+    # (t + m·p)/R < 12p²/R + p ≈ 2.51p: one conditional subtract restores
+    # the [0, 2p) contract (x ≥ 2p ⇒ x − 2p < 0.51p)
+    return _cond_sub(out, _TWO_P)
+
+
+def redc_cols_conv(t_cols: jnp.ndarray) -> jnp.ndarray:
+    """Full-width REDC via pad-convolutions (m = t·N' mod R, u = m·p) —
+    the graph-HEAVY variant; see `redc_cols` for why it is not the
+    default. Same contract."""
+    t = carry_scan(t_cols)
+    m_cols = _conv_cols_mod_r(t[..., :N_LIMBS], _NPRIME)
+    m = carry_scan(m_cols)  # mod R = drop the out-carry
+    u_cols = conv_cols(m, _P)
+    summed = carry_scan(t_cols + u_cols)
+    return _cond_sub(summed[..., N_LIMBS:], _TWO_P)
+
+
+# column offsets (canonical 64-limb forms of 4p² and 8p²) keeping lazy
+# combinations non-negative as INTEGERS (they are ≡ 0 mod p, so the
+# reduced value is unchanged):
+# - c0 = a0b0 − a1b1 + 4p²: a1b1 < (2p)² = 4p².
+# - c1 = s_a·s_b − a0b0 − a1b1 + 8p²: s_a = fp.add(a0, a1) may be the
+#   REDUCED representative (−2p), making the integer difference as low
+#   as −8p² — the mod-p value is right but `redc_cols` needs the
+#   non-negative integer (bug caught by the [p, 2p)-input differential
+#   tests; canonical-input tests cannot see it).
+FOUR_P2_COLS = jnp.asarray(
+    np.asarray(
+        [(4 * _P_INT * _P_INT >> (12 * i)) & 0xFFF for i in range(64)],
+        np.int32,
+    )
+)
+EIGHT_P2_COLS = jnp.asarray(
+    np.asarray(
+        [(8 * _P_INT * _P_INT >> (12 * i)) & 0xFFF for i in range(64)],
+        np.int32,
+    )
+)
+
+
+def _mul_padconv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery multiply via static pad-convolutions + full-width
+    conv-REDC (no lax.scan REDC, no dynamic slices) — the graph-heavy
+    experimental form; see `_default_impl`."""
+    return redc_cols_conv(conv_cols(a, b))
+
+
 def _mul_scan(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Word-serial Montgomery multiply (32-step REDC scan).
 
@@ -270,14 +379,19 @@ _DEFAULT_IMPL = None
 def _default_impl():
     """Pick the default multiply once per process: the word-serial scan.
 
-    Round-2 measurement (v5e, tools/kernel_probe.py): `_mul_fused` wins
-    microbenchmarks (21.9 vs 32.9 ms per 100 chained muls @4096) but
-    LOSES the full verifier kernel 13.4 s vs 5.2 s — the XLA matmul
-    cannot fuse its producer, so every conv materializes the 32×-blowup
-    outer product ((3,·,1024) f32, gigabytes per stacked tower mul) and
-    the kernel goes HBM-bandwidth-bound. The MXU design only pays off
-    VMEM-resident (Pallas — `ops/pallas_fp.py`); until that carries the
-    tower, the scan path is the default everywhere.
+    Round-4 record of the alternatives (tools/fp_probe.py, v5e):
+    - `_mul_padconv` (static pad-convs + m/u-conv REDC): 27.2 vs 32.2 ms
+      per 100-mul chain @4096 — WINS standalone but costs ~270 jaxpr
+      eqns/site vs the scan's ~75, inflating full-kernel compiles past
+      50 min (round-2 compile-size lesson). Opt-in:
+      LODESTAR_TPU_PADCONV_FP=1.
+    - Pallas MXU kernel (`ops/pallas_mxu.py`): VMEM-resident tiles fix
+      round 2's HBM blowup and win isolated chains ~1.25×, but ~200 µs
+      per-call in-graph launch latency loses the full kernel (867 vs
+      1001 sets/s). Opt-in: LODESTAR_TPU_PALLAS_MXU=1.
+    The lazy-reduction Fp2 tower keeps the real win compile-light: it
+    REMOVES a third of the REDCs and runs the rest through the same
+    word-serial scan (`redc_cols`).
     """
     global _DEFAULT_IMPL
     if _DEFAULT_IMPL is None:
@@ -298,6 +412,8 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """
     import os
 
+    if os.environ.get("LODESTAR_TPU_PADCONV_FP") == "1":
+        return _mul_padconv(a, b)
     if os.environ.get("LODESTAR_TPU_PALLAS_MXU") == "1":
         from .pallas_mxu import mont_mul
 

@@ -53,13 +53,35 @@ def _bcast(a, b):
     )
 
 
+def _lazy_enabled() -> bool:
+    import os
+
+    return os.environ.get("LODESTAR_TPU_LAZY_FP2", "1") != "0"
+
+
 def mul(a, b):
-    """Karatsuba: 3 Fp products in one stacked fp.mul call."""
+    """Karatsuba product.
+
+    Default: LAZY REDUCTION — 3 convolutions + 2 Montgomery reductions
+    (blst applies the same trick to this tower): the Karatsuba combines
+    happen on unreduced 64-column products, so one whole REDC is saved
+    per Fp2 product. c0's subtraction is offset by the constant 4p²
+    (keeping the value non-negative; soundness bound in `fp.redc_cols`).
+    LODESTAR_TPU_LAZY_FP2=0 restores the 3-full-multiply form."""
     a, b = _bcast(a, b)
     a0, a1 = _split(a)
     b0, b1 = _split(b)
     big_a = jnp.stack([a0, a1, fp.add(a0, a1)], axis=0)
     big_b = jnp.stack([b0, b1, fp.add(b0, b1)], axis=0)
+    if _lazy_enabled():
+        cols = fp.conv_cols(big_a, big_b)
+        p0, p1, p2 = cols[0], cols[1], cols[2]
+        c0_cols = p0 - p1 + fp.FOUR_P2_COLS
+        # 8p² offset: fp.add may have REDUCED (a0+a1) by 2p, so the
+        # integer p2 − p0 − p1 can reach −8p² (see fp.EIGHT_P2_COLS note)
+        c1_cols = p2 - p0 - p1 + fp.EIGHT_P2_COLS
+        out = fp.redc_cols(jnp.stack([c0_cols, c1_cols], axis=0))
+        return _join(out[0], out[1])
     p = fp.mul(big_a, big_b)
     p0, p1, p2 = p[0], p[1], p[2]
     c0 = fp.sub(p0, p1)  # a0b0 - a1b1
@@ -68,10 +90,16 @@ def mul(a, b):
 
 
 def square(a):
-    """(a0+a1u)² : c0 = (a0+a1)(a0−a1), c1 = 2·a0·a1 — 2 stacked Fp muls."""
+    """(a0+a1u)² : c0 = (a0+a1)(a0−a1), c1 = 2·a0·a1 — one stacked
+    convolution + one stacked reduction on the lazy path (2 full Fp muls
+    otherwise)."""
     a0, a1 = _split(a)
     big_a = jnp.stack([fp.add(a0, a1), a0], axis=0)
     big_b = jnp.stack([fp.sub(a0, a1), fp.add(a1, a1)], axis=0)
+    if _lazy_enabled():
+        cols = fp.conv_cols(big_a, big_b)
+        out = fp.redc_cols(cols)
+        return _join(out[0], out[1])
     p = fp.mul(big_a, big_b)
     return _join(p[0], p[1])
 

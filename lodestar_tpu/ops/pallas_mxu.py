@@ -218,6 +218,20 @@ def _mxu_tiles(a: jnp.ndarray, b: jnp.ndarray, interpret: bool, tile: int):
     )
 
 
+MIN_LANES = 4096  # below this, the ~200 us per-call launch latency loses
+# to the scan path (measured v5e round 4: full verifier kernel through the
+# Pallas path unconditionally = 867 sets/s vs 1001 scan — the small-batch
+# tail sites, e.g. the final-exponentiation chains at unit batch, pay the
+# fixed cost thousands of times). Override: LODESTAR_TPU_PALLAS_MIN_LANES.
+
+
+def _min_lanes() -> int:
+    import os
+
+    v = os.environ.get("LODESTAR_TPU_PALLAS_MIN_LANES")
+    return int(v) if v else MIN_LANES
+
+
 def mont_mul(
     a: jnp.ndarray,
     b: jnp.ndarray,
@@ -225,10 +239,18 @@ def mont_mul(
     tile: int = TILE,
 ) -> jnp.ndarray:
     """Drop-in for `ops.fp.mul`: framework layout (..., 32), broadcastable
-    batch axes, [0, 2p) lazy-reduction contract."""
+    batch axes, [0, 2p) lazy-reduction contract. Batches smaller than the
+    launch-latency break-even fall back to the word-serial scan."""
     if interpret is None:
         interpret = not _on_tpu()
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    n_flat = 1
+    for d in batch:
+        n_flat *= d
+    if n_flat < _min_lanes():
+        from . import fp as _fp
+
+        return _fp._mul_scan(a, b)
     a = jnp.broadcast_to(a, batch + (N_LIMBS,)).reshape(-1, N_LIMBS)
     b = jnp.broadcast_to(b, batch + (N_LIMBS,)).reshape(-1, N_LIMBS)
     n = a.shape[0]

@@ -341,3 +341,7 @@ def _neg_g1_pow2_table(nbits: int):
 
 
 NEG_G1_POW2_X, NEG_G1_POW2_Y = _neg_g1_pow2_table(32)
+# 64-bit variant: the per-set kernel's signature aggregate uses full
+# 64-bit random coefficients (no GLS split), so its plane lanes need
+# −[2^b]g1 for b = 0..63
+NEG_G1_POW2_64_X, NEG_G1_POW2_64_Y = _neg_g1_pow2_table(64)

@@ -66,6 +66,27 @@ def _local_body(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     return _fp12_product_tree(fs), s_part
 
 
+def _tail_on_root(mesh_axis, tail_fn):
+    """Run the sequential tail on chip 0 only and broadcast the verdict.
+
+    The tail (G2 affine inversion, one Miller lane, the final
+    exponentiation) is a latency-bound chain that cannot shard; running
+    it REPLICATED makes every chip burn the same wall-clock — harmless on
+    idle real chips but disastrous on a virtual CPU mesh where all
+    "devices" share host cores (round-3 MESH_SCALING regressed 145 → 66
+    sets/s from exactly this). Chip 0 computes, the rest contribute a
+    zero to the psum — the reference's analog is the main thread owning
+    aggregation while workers verify (`chain/bls/multithread/index.ts`)."""
+    is_root = lax.axis_index(mesh_axis) == 0
+    verdict_int = lax.cond(
+        is_root,
+        lambda _: tail_fn().astype(jnp.int32),
+        lambda _: jnp.int32(0),
+        operand=None,
+    )
+    return lax.psum(verdict_int, mesh_axis) > 0
+
+
 def _sharded_verify(mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     f_loc, s_part = _local_body(
         pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid
@@ -74,18 +95,20 @@ def _sharded_verify(mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, v
     f_all = lax.all_gather(f_loc, mesh_axis)          # (ndev, 2,3,2,32)
     s_all = jax.tree.map(lambda x: lax.all_gather(x, mesh_axis), s_part)
 
-    s = _g2_sum_tree(s_all)
-    s_inf = g2.is_infinity(s)
-    s_aff = g2.to_affine(s)
+    def tail():
+        s = _g2_sum_tree(s_all)
+        s_inf = g2.is_infinity(s)
+        s_aff = g2.to_affine(s)
+        # e(−g1, S) lane + cross-chip product + final exp
+        f_tail = miller_loop_projective(
+            (G1_GEN_X, fp.neg(G1_GEN_Y), fp.one(())),
+            (s_aff[0], s_aff[1]),
+        )
+        f_tail = fp12.select(~s_inf, f_tail, fp12.one(()))
+        f = fp12.mul(_fp12_product_tree(f_all), f_tail)
+        return fp12.is_one(final_exponentiation(f))
 
-    # replicated tail: e(−g1, S) lane + cross-chip product + final exp
-    f_tail = miller_loop_projective(
-        (G1_GEN_X, fp.neg(G1_GEN_Y), fp.one(())),
-        (s_aff[0], s_aff[1]),
-    )
-    f_tail = fp12.select(~s_inf, f_tail, fp12.one(()))
-    f = fp12.mul(_fp12_product_tree(f_all), f_tail)
-    return fp12.is_one(final_exponentiation(f))
+    return _tail_on_root(mesh_axis, tail)
 
 
 def make_sharded_verifier(mesh: Mesh, axis: str = "dp"):
@@ -186,7 +209,11 @@ def _grouped_local(
 def _sharded_grouped_verify(mesh_axis, *args):
     f_loc = _grouped_local(mesh_axis, *args)
     f_all = lax.all_gather(f_loc, mesh_axis)  # (ndev, 2,3,2,32)
-    return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+
+    def tail():
+        return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+
+    return _tail_on_root(mesh_axis, tail)
 
 
 def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):

@@ -6,9 +6,13 @@ AwaitingProcessing → Processing → AwaitingValidation), downloads from many
 peers concurrently with a peer balancer (`utils/peerBalancer.ts`), imports
 in order, retries failed batches with rotated peers (`batch.ts`).
 
-This implementation keeps the batch state machine and peer rotation; the
-download loop is synchronous rounds (the asyncio overlap arrives with the
-live transport)."""
+This implementation keeps the batch state machine and peer rotation, and
+overlaps download with import (VERDICT r3 #7): a bounded window of
+batches downloads concurrently on a thread pool (network I/O releases
+the GIL; the reference keeps ~`batchBuffer` batches in flight the same
+way, `sync/range/chain.ts:82`) while the import side consumes strictly
+in order — so the TPU verifier is never idle waiting on the wire, and
+the wire never waits on a long segment import."""
 
 from __future__ import annotations
 
@@ -19,6 +23,9 @@ from .peer import IPeer, PeerError
 
 EPOCHS_PER_BATCH = 2
 MAX_BATCH_RETRIES = 5
+DOWNLOAD_WINDOW = 4  # batches in flight ahead of the import cursor
+# (reference: SyncChain keeps batchBuffer=5 epochs of batches downloading
+# while processing sequentially — sync/range/chain.ts)
 
 
 class BatchStatus(str, Enum):
@@ -38,6 +45,7 @@ class SyncBatch:
     blocks: list = field(default_factory=list)
     failed_attempts: int = 0
     failed_peers: set[str] = field(default_factory=set)
+    rr_offset: int = 0  # spreads concurrent first attempts over peers
 
 
 class RangeSyncError(Exception):
@@ -47,7 +55,8 @@ class RangeSyncError(Exception):
 class RangeSync:
     def __init__(
         self, chain, types, slots_per_epoch: int, verify_signatures: bool = True,
-        metrics=None,
+        metrics=None, download_window: int = DOWNLOAD_WINDOW,
+        epochs_per_batch: int = EPOCHS_PER_BATCH,
     ):
         self.chain = chain
         self.types = types
@@ -55,6 +64,8 @@ class RangeSync:
         self.verify_signatures = verify_signatures
         self.peers: list[IPeer] = []
         self.metrics = metrics
+        self.download_window = max(1, download_window)
+        self.epochs_per_batch = max(1, epochs_per_batch)
 
     def _export_batch_states(self, batches) -> None:
         if self.metrics is None:
@@ -76,8 +87,12 @@ class RangeSync:
             candidates = self.peers
         if not candidates:
             raise RangeSyncError("no peers")
-        # least-recently-failed first, stable rotation by attempt count
-        return candidates[batch.failed_attempts % len(candidates)]
+        # rotate by attempt count (every retry lands on a DIFFERENT peer —
+        # deterministic, so two peers always alternate) offset by the
+        # batch's fixed index (concurrent window batches spread over the
+        # peer set instead of piling on peers[0] — the reference's
+        # peerBalancer assigns idle peers first)
+        return candidates[(batch.failed_attempts + batch.rr_offset) % len(candidates)]
 
     # -- driving -------------------------------------------------------------
 
@@ -88,19 +103,40 @@ class RangeSync:
         peer rotation), processes in order — one round-trip of the
         reference's state machine per batch."""
         head_slot = self.chain.head_state.state.slot
-        batch_span = EPOCHS_PER_BATCH * self.spe
+        batch_span = self.epochs_per_batch * self.spe
         batches: list[SyncBatch] = []
         start = head_slot + 1
         while start <= target_slot:
             count = min(batch_span, target_slot - start + 1)
-            batches.append(SyncBatch(start_slot=start, count=count))
+            batches.append(
+                SyncBatch(start_slot=start, count=count, rr_offset=len(batches))
+            )
             start += count
 
-        for batch in batches:
-            self._export_batch_states(batches)
-            self._download(batch)
-            self._process(batch)
-            self._export_batch_states(batches)
+        if not batches:
+            return head_slot
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        window = self.download_window
+        with ThreadPoolExecutor(
+            max_workers=window, thread_name_prefix="range-dl"
+        ) as pool:
+            futures: dict[int, object] = {}
+
+            def top_up(cursor: int) -> None:
+                hi = min(len(batches), cursor + window)
+                for j in range(cursor, hi):
+                    if j not in futures:
+                        futures[j] = pool.submit(self._download, batches[j])
+
+            for i, batch in enumerate(batches):
+                top_up(i)
+                self._export_batch_states(batches)
+                futures.pop(i).result()  # raises if download exhausted retries
+                top_up(i + 1)  # keep the window full while we import
+                self._process(batch)
+                self._export_batch_states(batches)
         return self.chain.head_state.state.slot
 
     def _download(self, batch: SyncBatch) -> None:

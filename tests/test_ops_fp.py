@@ -188,3 +188,36 @@ def test_cyclotomic_square_matches_oracle():
         limbs = fq12_to_limbs(g)
         got = limbs_to_fq12(np.asarray(jax.jit(fp12.cyclotomic_square)(limbs)))
         assert got == g * g
+
+
+def test_lazy_fp2_with_nonreduced_representatives():
+    """The lazy-reduction Fp2 product must be correct for inputs anywhere
+    in the [0, 2p) contract, not just canonical < p values: fp.add's
+    conditional 2p-reduction makes the integer p2 − p0 − p1 negative
+    about half the time at the top of the range (the 8p² offset exists
+    exactly for this — a canonical-only test cannot see the bug)."""
+    import numpy as np
+
+    from lodestar_tpu.bls.fields import P
+    from lodestar_tpu.ops import fp2
+    from lodestar_tpu.ops.limbs import R_MONT, int_to_limbs, limbs_to_int
+
+    rng2 = np.random.default_rng(9)
+    r_inv = pow(R_MONT, -1, P)
+    for _ in range(10):
+        a0 = P + int(rng2.integers(0, 2**60)) ** 6 % P
+        a1 = P + int(rng2.integers(0, 2**60)) ** 6 % P
+        b0 = P + int(rng2.integers(0, 2**60)) ** 6 % P
+        b1 = int(rng2.integers(0, 2**60)) ** 6 % P
+        a = jnp.asarray(np.stack([int_to_limbs(a0), int_to_limbs(a1)])[None])
+        b = jnp.asarray(np.stack([int_to_limbs(b0), int_to_limbs(b1)])[None])
+        out = np.asarray(fp2.mul(a, b))[0]
+        c0, c1 = limbs_to_int(out[0]), limbs_to_int(out[1])
+        assert c0 < 2 * P and c1 < 2 * P
+        assert c0 % P == (a0 * b0 - a1 * b1) * r_inv % P
+        assert c1 % P == (a0 * b1 + a1 * b0) * r_inv % P
+        sq = np.asarray(fp2.square(a))[0]
+        s0, s1 = limbs_to_int(sq[0]), limbs_to_int(sq[1])
+        assert s0 < 2 * P and s1 < 2 * P
+        assert s0 % P == (a0 * a0 - a1 * a1) * r_inv % P
+        assert s1 % P == 2 * a0 * a1 * r_inv % P

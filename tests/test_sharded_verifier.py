@@ -1,0 +1,146 @@
+"""Multi-chip sharded verification on the 8-device virtual CPU mesh
+(VERDICT r3 weak #4 / next-round #3: the `cpu_mesh` fixture finally has
+consumers).
+
+Covers the ICI tier (`parallel/sharded.py`): verdict parity with the
+single-device kernels on identical batches, one-invalid-lane rejection
+through `shard_map`, batches that do not fill the lane grid (padding
+lanes cross chip boundaries), and the grouped (shared-signing-root)
+variant. Shapes are deliberately tiny — the point is the collective
+path, not throughput (tools/mesh_scaling.py measures that).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.parallel.sharded import (
+    ShardedBlsVerifier,
+    ShardedGroupedVerifier,
+)
+from lodestar_tpu.parallel.verifier import (
+    TpuBlsVerifier,
+    _rand_bits,
+    _rand_pairs,
+)
+
+pytestmark = pytest.mark.slow
+
+_COUNTER = [0]
+
+
+def _det_rng():
+    _COUNTER[0] += 1
+    return (0x9E3779B97F4A7C15 * _COUNTER[0]) & ((1 << 64) - 1)
+
+
+def _make_sets(n, salt=0, root=None):
+    sets = []
+    for i in range(n):
+        sk = bls.interop_secret_key(i + salt)
+        msg = root if root is not None else bytes([i ^ 0xA5]) * 32
+        sets.append(
+            bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+    return sets
+
+
+def _tamper(sets, idx):
+    wrong = bls.interop_secret_key(99)
+    sets = list(sets)
+    sets[idx] = bls.SignatureSet(
+        pubkey=sets[idx].pubkey,
+        message=sets[idx].message,
+        signature=wrong.sign(sets[idx].message).to_bytes(),
+    )
+    return sets
+
+
+@pytest.fixture(scope="module")
+def host():
+    """Single-device verifier used only for its marshalling (wire bytes →
+    limb arrays) and as the parity oracle."""
+    return TpuBlsVerifier(buckets=(16,), rng=_det_rng,
+                          grouped_configs=((8, 4),))
+
+
+def test_sharded_parity_with_single_device(cpu_mesh, host):
+    sharded = ShardedBlsVerifier(cpu_mesh, lanes_per_chip=2)
+    sets = _make_sets(16)
+    arrs = host._marshal(sets)
+    assert arrs is not None
+    r_bits = _rand_bits(16, host._rng)
+    assert bool(host.kernels.verify_batch(arrs, r_bits))
+    assert sharded.verify_arrays(arrs, r_bits) is True
+
+    bad = host._marshal(_tamper(sets, 5))
+    assert bool(host.kernels.verify_batch(bad, r_bits)) is False
+    assert sharded.verify_arrays(bad, r_bits) is False
+
+
+def test_sharded_invalid_lane_on_any_chip(cpu_mesh, host):
+    """The tampered lane must be caught wherever it lands in the shard
+    grid — first chip, middle, and the last chip's last lane."""
+    sharded = ShardedBlsVerifier(cpu_mesh, lanes_per_chip=2)
+    sets = _make_sets(16)
+    r_bits = _rand_bits(16, host._rng)
+    for idx in (0, 7, 15):
+        bad = host._marshal(_tamper(sets, idx))
+        assert sharded.verify_arrays(bad, r_bits) is False, idx
+
+
+def test_sharded_partial_batch_padding(cpu_mesh, host):
+    """n < lane grid: padding lanes (valid=False) span whole chips — the
+    masked-to-infinity convention must hold across shard boundaries."""
+    sharded = ShardedBlsVerifier(cpu_mesh, lanes_per_chip=2)
+    sets = _make_sets(5)
+    arrs = host._marshal(sets)  # bucket 16 → 11 padding lanes
+    assert arrs is not None and arrs.n == 5
+    r_bits = _rand_bits(16, host._rng)
+    assert sharded.verify_arrays(arrs, r_bits) is True
+    bad = host._marshal(_tamper(sets, 4))
+    assert sharded.verify_arrays(bad, r_bits) is False
+
+
+def test_sharded_grouped_parity_and_rejection(cpu_mesh, host):
+    """Grouped tier: 8 root-rows × 4 lanes over 8 chips (1 row each);
+    verdict parity with the single-device grouped kernel and rejection
+    of a tampered lane."""
+    sharded = ShardedGroupedVerifier(cpu_mesh)
+    # two committees, shared root within each → groups well
+    sets = _make_sets(8, root=b"\x42" * 32) + _make_sets(
+        8, salt=20, root=b"\x43" * 32
+    )
+    plan = host._plan_groups(sets)
+    assert plan is not None
+    g = host._marshal_grouped(sets, plan)
+    assert g is not None
+    a_bits, b_bits = _rand_pairs(g.valid.shape, _det_rng)
+    assert bool(host.kernels.verify_grouped(g, a_bits, b_bits))
+    assert sharded.verify_grouped(g, a_bits, b_bits) is True
+
+    bad_sets = _tamper(sets, 3)
+    gb = host._marshal_grouped(bad_sets, host._plan_groups(bad_sets))
+    assert gb is not None
+    assert bool(host.kernels.verify_grouped(gb, a_bits, b_bits)) is False
+    assert sharded.verify_grouped(gb, a_bits, b_bits) is False
+
+
+def test_sharded_grouped_refuses_non_dividing_mesh():
+    """A mesh that does not divide the 64 constant lanes must refuse
+    loudly (silent lane-dropping would reject every batch)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from lodestar_tpu.parallel.sharded import make_sharded_grouped_verifier
+
+    devices = np.array(jax.devices("cpu")[:6])
+    if len(devices) < 6:
+        pytest.skip("needs 6 virtual devices")
+    mesh = Mesh(devices.reshape(6), axis_names=("dp",))
+    with pytest.raises(ValueError, match="must divide"):
+        make_sharded_grouped_verifier(mesh)

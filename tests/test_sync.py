@@ -167,3 +167,101 @@ def test_segment_import_batches_signatures_once():
     with _pytest.raises(BlockImportError):
         importer2.process_block_segment(bad_segment, verify_signatures=True)
     assert importer2.head_state.state.slot == 0
+
+
+def test_range_sync_download_import_overlap(two_nodes):
+    """VERDICT r3 #7: with a window of batches in flight, later batches
+    must be DOWNLOADING while an earlier batch is PROCESSING — measured
+    by interval overlap, not throughput luck. A slow-peer wrapper stamps
+    each download span; the chain import is stamped via monkeypatched
+    segment processing."""
+    import time
+
+    config, types, node_a, _ = two_nodes
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    fresh = interop_genesis_state(fork_config, types, N, genesis_time=1_600_000_000)
+    node_d = BeaconChain(config, types, fresh)
+    node_d.clock.set_slot(2 * SPE)
+
+    dl_spans: list[tuple[int, float, float]] = []
+    proc_spans: list[tuple[float, float]] = []
+
+    class SlowPeer:
+        def __init__(self, inner, delay):
+            self._inner = inner
+            self._delay = delay
+            self.peer_id = inner.peer_id
+
+        def status(self):
+            return self._inner.status()
+
+        def beacon_blocks_by_range(self, start_slot, count):
+            t0 = time.monotonic()
+            time.sleep(self._delay)  # wire latency the import should hide
+            out = self._inner.beacon_blocks_by_range(start_slot, count)
+            dl_spans.append((start_slot, t0, time.monotonic()))
+            return out
+
+        def beacon_blocks_by_root(self, roots):
+            return self._inner.beacon_blocks_by_root(roots)
+
+    inner = LocalPeer("nodeA", ReqRespHandlers(config, types, node_a), types)
+    # 4-slot batches (half-epoch span) → 4 batches over the 2 produced
+    # epochs, window 2: batch 3's download must start while batch 1
+    # imports
+    rs = RangeSync(
+        node_d, types, SPE // 2, verify_signatures=False,
+        epochs_per_batch=1, download_window=2,
+    )
+    # several slow peers so the window can download concurrently
+    for i in range(3):
+        rs.add_peer(SlowPeer(inner, delay=0.15))
+
+    real_process = node_d.process_block_segment
+
+    def stamped_process(blocks, **kw):
+        t0 = time.monotonic()
+        out = real_process(blocks, **kw)
+        time.sleep(0.05)  # give the import span measurable width
+        proc_spans.append((t0, time.monotonic()))
+        return out
+
+    node_d.process_block_segment = stamped_process
+    head = rs.sync_to(2 * SPE)
+    assert head == 2 * SPE
+    assert node_d.head_root == node_a.head_root
+
+    # ≥2 batches (2 epochs / EPOCHS_PER_BATCH-epoch batches ≥ 1)… the
+    # overlap claim needs at least two download spans and one process span
+    assert len(dl_spans) >= 2 and len(proc_spans) >= 1
+    overlap = any(
+        dl_start < p_end and p_start < dl_end
+        for _, dl_start, dl_end in dl_spans
+        for p_start, p_end in proc_spans
+    )
+    assert overlap, (dl_spans, proc_spans)
+
+
+def test_range_sync_retries_with_rotation_under_window(two_nodes):
+    """Peer rotation must survive the concurrent window: a peer that
+    always fails is rotated away from, and the batch still completes."""
+    config, types, node_a, _ = two_nodes
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    fresh = interop_genesis_state(fork_config, types, N, genesis_time=1_600_000_000)
+    node_e = BeaconChain(config, types, fresh)
+    node_e.clock.set_slot(2 * SPE)
+
+    from lodestar_tpu.sync.peer import PeerError
+
+    class FlakyPeer:
+        peer_id = "flaky"
+
+        def beacon_blocks_by_range(self, start_slot, count):
+            raise PeerError("always down")
+
+    good = LocalPeer("nodeA", ReqRespHandlers(config, types, node_a), types)
+    rs = RangeSync(node_e, types, SPE, verify_signatures=False)
+    rs.add_peer(FlakyPeer())
+    rs.add_peer(good)
+    assert rs.sync_to(2 * SPE) == 2 * SPE
+    assert node_e.head_root == node_a.head_root

@@ -27,6 +27,8 @@ elif mode == "pallas":
     os.environ["LODESTAR_TPU_PALLAS_MUL"] = "1"
 elif mode == "mxu2":
     os.environ["LODESTAR_TPU_PALLAS_MXU"] = "1"
+elif mode == "padconv":
+    os.environ["LODESTAR_TPU_PADCONV_FP"] = "1"
 
 from lodestar_tpu.ops import fp  # noqa: E402
 
