@@ -276,6 +276,13 @@ class ThreadBufferedVerifier:
         self._timer: object | None = None
         self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
 
+    def __getattr__(self, name):
+        # delegate everything else (stop_profiling, max_sets_per_job, …)
+        # to the wrapped verifier — the facade adds batching, not surface
+        if name == "verifier":  # not yet set (unpickling/copy): no recursion
+            raise AttributeError(name)
+        return getattr(self.verifier, name)
+
     # non-batchable path parity: chain code that must not wait calls this
     def verify_signature_sets_individual(self, sets):
         return self.verifier.verify_signature_sets_individual(sets)

@@ -199,7 +199,11 @@ def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
         return ValidationResult(GossipAction.REJECT, "wrong proposer")
     try:
         sig_set = block_proposer_signature_set(state, signed_block)
-        if not chain.bls.verify_signature_sets([sig_set]):
+        # blocks are latency-critical (each gossip hop re-validates):
+        # never sit out a batching facade's wait window
+        from .chain import _verify_now
+
+        if not _verify_now(chain.bls, [sig_set]):
             return ValidationResult(GossipAction.REJECT, "invalid proposer signature")
     except Exception:
         return ValidationResult(GossipAction.IGNORE, "cannot build signature set")

@@ -253,14 +253,25 @@ def redc_cols(t_cols: jnp.ndarray) -> jnp.ndarray:
 
     `t_cols` (..., 2N) int32 columns of a NON-NEGATIVE value < 12p²
     (columns may be negative). GRAPH-LIGHT: the reduction is the proven
-    word-serial `lax.scan` applied DIRECTLY to the signed columns — only
-    the low 12 bits of a column feed the m-digit, and arithmetic shifts
-    ripple negative carries, so no prior normalization is needed (≈12
-    jaxpr eqns total). The full-width m/u-convolution form costs ~200
-    eqns per site and blew kernel compiles past 50 min (the round-2
-    compile-size lesson, relearned on the lazy tower; `redc_cols_conv`
-    keeps that form for experiments)."""
-    t = t_cols
+    word-serial `lax.scan` (`_redc_scan` — the ONE copy of the
+    consensus-critical pipeline, shared with `_mul_scan`) applied
+    DIRECTLY to the signed columns — only the low 12 bits of a column
+    feed the m-digit, and arithmetic shifts ripple negative carries, so
+    no prior normalization is needed (≈12 jaxpr eqns total). The
+    full-width m/u-convolution form costs ~200 eqns per site and blew
+    kernel compiles past 50 min (the round-2 compile-size lesson,
+    relearned on the lazy tower; `redc_cols_conv` keeps that form for
+    experiments)."""
+    out = carry_scan(_redc_scan(t_cols)[..., N_LIMBS:])
+    # (t + m·p)/R < 12p²/R + p ≈ 2.51p: one conditional subtract restores
+    # the [0, 2p) contract (x ≥ 2p ⇒ x − 2p < 0.51p)
+    return _cond_sub(out, _TWO_P)
+
+
+def _redc_scan(t: jnp.ndarray) -> jnp.ndarray:
+    """The word-serial Montgomery reduction scan over (..., 2N) columns —
+    kills one low limb per step; accepts signed, uncarried columns. The
+    single shared implementation behind `_mul_scan` and `redc_cols`."""
 
     def redc_step(acc, i):
         chunk = lax.dynamic_slice_in_dim(acc, i, N_LIMBS, axis=-1)
@@ -271,11 +282,8 @@ def redc_cols(t_cols: jnp.ndarray) -> jnp.ndarray:
         chunk = chunk.at[..., 0:1].set(0)
         return lax.dynamic_update_slice_in_dim(acc, chunk, i, axis=-1), None
 
-    t, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
-    out = carry_scan(t[..., N_LIMBS:])
-    # (t + m·p)/R < 12p²/R + p ≈ 2.51p: one conditional subtract restores
-    # the [0, 2p) contract (x ≥ 2p ⇒ x − 2p < 0.51p)
-    return _cond_sub(out, _TWO_P)
+    out, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
+    return out
 
 
 def redc_cols_conv(t_cols: jnp.ndarray) -> jnp.ndarray:
@@ -332,18 +340,7 @@ def _mul_scan(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     t = jnp.zeros(batch + (2 * N_LIMBS,), dtype=jnp.int32)
     for i in range(N_LIMBS):  # static unroll: 32 vector multiply-adds
         t = t.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
-
-    def redc_step(t, i):
-        chunk = lax.dynamic_slice_in_dim(t, i, N_LIMBS, axis=-1)
-        m = (chunk[..., 0:1] * N0) & LIMB_MASK
-        chunk = chunk + m * _P
-        carry = chunk[..., 0:1] >> LIMB_BITS  # low limb is ≡ 0 mod 2^12 now
-        chunk = chunk.at[..., 1:2].add(carry)
-        chunk = chunk.at[..., 0:1].set(0)
-        return lax.dynamic_update_slice_in_dim(t, chunk, i, axis=-1), None
-
-    t, _ = lax.scan(redc_step, t, jnp.arange(N_LIMBS))
-    return carry_scan(t[..., N_LIMBS:])
+    return carry_scan(_redc_scan(t)[..., N_LIMBS:])
 
 
 def _mul_fused(a: jnp.ndarray, b: jnp.ndarray, carry=None) -> jnp.ndarray:

@@ -59,6 +59,27 @@ def _lazy_enabled() -> bool:
     return os.environ.get("LODESTAR_TPU_LAZY_FP2", "1") != "0"
 
 
+def _lazy_max_elems() -> int:
+    import os
+
+    v = os.environ.get("LODESTAR_TPU_LAZY_FP2_MAX_ELEMS")
+    return int(v) if v else 1 << 24
+
+
+def _use_lazy(big_a) -> bool:
+    """Lazy reduction doubles the live intermediate width (64 columns);
+    at the grouped kernel's subset-table shapes (75M-element stacks) that
+    tipped the 64×256 gossip batch over HBM — huge stacked products fall
+    back to the classic 3-multiply form (whose REDC interleaves in-scan
+    and keeps the working set at 32 limbs)."""
+    if not _lazy_enabled():
+        return False
+    n = 1
+    for d in big_a.shape:
+        n *= d
+    return n <= _lazy_max_elems()
+
+
 def mul(a, b):
     """Karatsuba product.
 
@@ -73,7 +94,7 @@ def mul(a, b):
     b0, b1 = _split(b)
     big_a = jnp.stack([a0, a1, fp.add(a0, a1)], axis=0)
     big_b = jnp.stack([b0, b1, fp.add(b0, b1)], axis=0)
-    if _lazy_enabled():
+    if _use_lazy(big_a):
         cols = fp.conv_cols(big_a, big_b)
         p0, p1, p2 = cols[0], cols[1], cols[2]
         c0_cols = p0 - p1 + fp.FOUR_P2_COLS
@@ -96,7 +117,7 @@ def square(a):
     a0, a1 = _split(a)
     big_a = jnp.stack([fp.add(a0, a1), a0], axis=0)
     big_b = jnp.stack([fp.sub(a0, a1), fp.add(a1, a1)], axis=0)
-    if _lazy_enabled():
+    if _use_lazy(big_a):
         cols = fp.conv_cols(big_a, big_b)
         out = fp.redc_cols(cols)
         return _join(out[0], out[1])

@@ -340,8 +340,8 @@ def _neg_g1_pow2_table(nbits: int):
     return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
 
 
-NEG_G1_POW2_X, NEG_G1_POW2_Y = _neg_g1_pow2_table(32)
-# 64-bit variant: the per-set kernel's signature aggregate uses full
-# 64-bit random coefficients (no GLS split), so its plane lanes need
-# −[2^b]g1 for b = 0..63
+# 64 entries: the per-set kernel's signature aggregate uses full 64-bit
+# random coefficients (no GLS split) and needs −[2^b]g1 for b = 0..63;
+# the grouped kernel's 32-bit halves use the prefix
 NEG_G1_POW2_64_X, NEG_G1_POW2_64_Y = _neg_g1_pow2_table(64)
+NEG_G1_POW2_X, NEG_G1_POW2_Y = NEG_G1_POW2_64_X[:32], NEG_G1_POW2_64_Y[:32]

@@ -373,6 +373,11 @@ class BatchVerifier:
         for _, lanes in self.grouped_configs:
             if lanes % 4 != 0:
                 raise ValueError("grouped lanes_per_row must be a multiple of 4")
+        for b in self.buckets:
+            if b % 4 != 0:
+                # the per-set kernel's bit-plane signature sums use
+                # subset-4 tables (ops/msm.py): lane counts must divide
+                raise ValueError("buckets must be multiples of 4")
         self._batch = jax.jit(batch_verify_kernel)
         self._individual = jax.jit(individual_verify_kernel)
         self._grouped = jax.jit(grouped_verify_kernel)
@@ -439,11 +444,12 @@ class TpuBlsVerifier:
         # steady-state work. The reference holds decompressed pubkeys in
         # its Index2PubkeyCache for exactly this reason (worker.ts
         # "deserializes affine without re-checking"). Bounded FIFO like
-        # the h2c cache; ~256 B/entry → default cap ≈ 134 MB, enough for
-        # every active mainnet validator.
+        # the h2c cache; ~256 B/entry → the 2^21 default (~537 MB) holds
+        # every active mainnet validator with headroom — a cap BELOW the
+        # active set would thrash to 0% hits at exactly the target scale.
         self._pk_cache: dict[bytes, tuple] = {}
         self._pk_cache_max = int(
-            __import__("os").environ.get("LODESTAR_TPU_PK_CACHE_MAX", 1 << 19)
+            __import__("os").environ.get("LODESTAR_TPU_PK_CACHE_MAX", 1 << 21)
         )
         self._pk_lock = threading.Lock()
 
