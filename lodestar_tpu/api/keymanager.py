@@ -148,6 +148,18 @@ def create_keymanager_server(store, host: str = "127.0.0.1", port: int = 0,
         import secrets as _secrets
 
         bearer_token = "api-token-0x" + _secrets.token_hex(16)
+        if token_file is None:
+            # a generated token nobody can read makes the API unusable,
+            # but logging the secret itself would persist a live
+            # credential in log history — so persist it the way the
+            # reference does (api-token.txt, owner-only) and log only
+            # the path.
+            token_file = "api-token.txt"
+        from ..utils.logger import get_logger
+
+        get_logger("keymanager").info(
+            "generated keymanager bearer token; written to %s", token_file
+        )
     if token_file is not None:
         import os
 

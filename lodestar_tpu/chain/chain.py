@@ -51,11 +51,32 @@ from .state_cache import CheckpointStateCache, StateContextCache
 def _verify_now(verifier, sets) -> bool:
     """verify_signature_sets with batchable=False where the facade
     supports it (block/segment import must not wait out a gossip
-    batching window)."""
-    try:
+    batching window).
+
+    Support is detected ONCE per verifier type from the signature — not
+    by catching TypeError around the live call, which would swallow a
+    genuine TypeError raised inside verification (malformed set
+    contents) and silently re-run the whole batch."""
+    cls = type(verifier)
+    supports = _VERIFY_NOW_SUPPORT.get(cls)
+    if supports is None:
+        import inspect
+
+        try:
+            sig = inspect.signature(verifier.verify_signature_sets)
+            supports = "batchable" in sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()
+            )
+        except (ValueError, TypeError):  # builtins without signatures
+            supports = False
+        _VERIFY_NOW_SUPPORT[cls] = supports
+    if supports:
         return verifier.verify_signature_sets(sets, batchable=False)
-    except TypeError:
-        return verifier.verify_signature_sets(sets)
+    return verifier.verify_signature_sets(sets)
+
+
+_VERIFY_NOW_SUPPORT: dict = {}
 
 
 class BlockImportError(ValueError):

@@ -77,37 +77,58 @@ def run_validator(args) -> int:
         return 1
     log.info("%d validator keys loaded", len(store.pubkeys))
 
-    doppelganger = DoppelgangerService() if args.doppelganger else None
-    service = RestValidatorService(config, types, client, store, doppelganger)
-    genesis_time = int(genesis["genesis_time"])
-    if doppelganger is not None:
-        current_epoch = max(
-            0,
-            int(time.time() - genesis_time)
-            // (config.SECONDS_PER_SLOT * preset.SLOTS_PER_EPOCH),
-        )
-        service.resolve_indices()
-        for idx in service._indices.values():
-            doppelganger.register(idx, current_epoch)
+    keymanager_server = None
+    if args.keymanager:
+        from ..api.keymanager import create_keymanager_server
 
-    stop = {"flag": False}
-    signal.signal(signal.SIGINT, lambda s, f: stop.update(flag=True))
-    spt = config.SECONDS_PER_SLOT
-    last_slot = -1
-    deadline = time.time() + args.run_seconds if args.run_seconds else None
-    while not stop["flag"]:
-        now = time.time()
-        if deadline and now >= deadline:
-            break
-        slot = max(0, int(now - genesis_time) // spt)
-        if slot != last_slot:
-            try:
-                service.on_slot(slot)
-            except Exception as e:
-                log.error("slot %d: %s", slot, e)
-            last_slot = slot
-        time.sleep(min(0.2, spt / 10))
-    return 0
+        # args.datadir is the FileDb log FILE path, not a directory —
+        # the token lives beside it as <datadir>.api-token.txt
+        token_file = args.datadir + ".api-token.txt" if args.datadir else None
+        keymanager_server = create_keymanager_server(
+            store, port=args.keymanager_port, token_file=token_file
+        )
+        keymanager_server.start()
+        log.info(
+            "keymanager API on port %d (token file: %s)",
+            keymanager_server.port,
+            token_file or "api-token.txt",
+        )
+
+    try:
+        doppelganger = DoppelgangerService() if args.doppelganger else None
+        service = RestValidatorService(config, types, client, store, doppelganger)
+        genesis_time = int(genesis["genesis_time"])
+        if doppelganger is not None:
+            current_epoch = max(
+                0,
+                int(time.time() - genesis_time)
+                // (config.SECONDS_PER_SLOT * preset.SLOTS_PER_EPOCH),
+            )
+            service.resolve_indices()
+            for idx in service._indices.values():
+                doppelganger.register(idx, current_epoch)
+
+        stop = {"flag": False}
+        signal.signal(signal.SIGINT, lambda s, f: stop.update(flag=True))
+        spt = config.SECONDS_PER_SLOT
+        last_slot = -1
+        deadline = time.time() + args.run_seconds if args.run_seconds else None
+        while not stop["flag"]:
+            now = time.time()
+            if deadline and now >= deadline:
+                break
+            slot = max(0, int(now - genesis_time) // spt)
+            if slot != last_slot:
+                try:
+                    service.on_slot(slot)
+                except Exception as e:
+                    log.error("slot %d: %s", slot, e)
+                last_slot = slot
+            time.sleep(min(0.2, spt / 10))
+        return 0
+    finally:
+        if keymanager_server is not None:
+            keymanager_server.close()
 
 
 def add_validator_parser(sub) -> None:
@@ -120,5 +141,7 @@ def add_validator_parser(sub) -> None:
     p.add_argument("--keystores-password-file", default=None)
     p.add_argument("--external-signer-url", default=None, help="web3signer-compatible endpoint")
     p.add_argument("--doppelganger", action="store_true", help="enable doppelganger protection")
+    p.add_argument("--keymanager", action="store_true", help="serve the keymanager API")
+    p.add_argument("--keymanager-port", type=int, default=5062)
     p.add_argument("--run-seconds", type=float, default=0)
     p.set_defaults(func=run_validator)

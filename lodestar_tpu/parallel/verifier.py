@@ -444,10 +444,14 @@ class TpuBlsVerifier:
         # steady-state work. The reference holds decompressed pubkeys in
         # its Index2PubkeyCache for exactly this reason (worker.ts
         # "deserializes affine without re-checking"). Bounded FIFO like
-        # the h2c cache; ~256 B/entry → the 2^21 default (~537 MB) holds
-        # every active mainnet validator with headroom — a cap BELOW the
-        # active set would thrash to 0% hits at exactly the target scale.
-        self._pk_cache: dict[bytes, tuple] = {}
+        # the h2c cache. Each entry is ONE packed (2·N_LIMBS,) int32
+        # array (x‖y) — 256 B of limb data + one ndarray header + dict
+        # slot + 48-B key ≈ 550 B/entry, so the 2^21 default costs
+        # ~1.1 GB host RAM and holds every active mainnet validator with
+        # headroom — a cap BELOW the active set would thrash to 0% hits
+        # at exactly the target scale. Smaller hosts should set
+        # LODESTAR_TPU_PK_CACHE_MAX (2^20 ≈ 0.55 GB still covers 1M).
+        self._pk_cache: dict[bytes, "np.ndarray"] = {}
         self._pk_cache_max = int(
             __import__("os").environ.get("LODESTAR_TPU_PK_CACHE_MAX", 1 << 21)
         )
@@ -504,7 +508,7 @@ class TpuBlsVerifier:
                 rc, limbs = _native.bls_g1_decompress(k, check_subgroup=False)
                 if rc != 0:
                     return None  # infinity pubkey is invalid per Eth2
-                fresh[k] = (limbs[0], limbs[1])
+                fresh[k] = np.concatenate((limbs[0], limbs[1]))
             with self._pk_lock:
                 cache = self._pk_cache
                 for k, v in fresh.items():
@@ -518,9 +522,9 @@ class TpuBlsVerifier:
         n = len(sets)
         pk_x = np.empty((n, N_LIMBS), np.int32)
         pk_y = np.empty((n, N_LIMBS), np.int32)
-        for i, (x, y) in enumerate(rows):
-            pk_x[i] = x
-            pk_y[i] = y
+        for i, r in enumerate(rows):
+            pk_x[i] = r[:N_LIMBS]
+            pk_y[i] = r[N_LIMBS:]
         return pk_x, pk_y
 
     def _native_limbs(self, sets):

@@ -16,6 +16,13 @@ from ..network.reqresp import (
 
 
 class IPeer(Protocol):
+    """A sync-usable remote peer.
+
+    Implementations are NOT required to be thread-safe: callers that
+    issue requests from multiple threads (e.g. RangeSync's download
+    window) must serialize access per peer — a transport multiplexing
+    one stream per peer would otherwise interleave request frames."""
+
     peer_id: str
 
     def status(self): ...

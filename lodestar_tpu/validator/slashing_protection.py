@@ -201,12 +201,35 @@ class SlashingProtection:
                     "pruned_below": rec.get("pruned_below", -1),
                 },
             )
+            # A retained vote wider than max_epoch_lookback (long
+            # non-finality) can make a later replayed vote's source fall
+            # below the advancing span floor and fail mid-replay. Such
+            # votes must not vanish silently: raise pruned_below to the
+            # highest lost target so future votes at (or below) those
+            # targets are refused instead of passing the double-vote
+            # check against an emptied window.
+            lost_targets = []
             for s, t, root in replay:
-                self.check_and_insert_attestation(
-                    pubkey, s, t, bytes.fromhex(root)
+                try:
+                    self.check_and_insert_attestation(
+                        pubkey, s, t, bytes.fromhex(root)
+                    )
+                except SlashingError:
+                    lost_targets.append(t)
+            if lost_targets:
+                poisoned = self.atts.get(pubkey) or {}
+                poisoned["pruned_below"] = max(
+                    poisoned.get("pruned_below", -1), max(lost_targets)
                 )
+                self.atts.put(pubkey, poisoned)
             rec = self.atts.get(pubkey) or {}
             targets = rec.get("targets", {})
+            # the in-flight vote must re-pass the prune gate against the
+            # migrated record (pruned_below may have advanced just now)
+            if target_epoch <= rec.get("pruned_below", -1):
+                raise SlashingError(
+                    f"target {target_epoch} below retained history"
+                )
 
         # min-max-surround in O(1): spans answer both directions without
         # consulting (possibly pruned) individual votes

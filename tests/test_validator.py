@@ -145,6 +145,33 @@ class TestSlashingProtection:
         # normal progression continues
         protection.check_and_insert_attestation(PK, 61, 62, b"\x04" * 32)
 
+    def test_migration_replay_failure_raises_pruned_below(self):
+        """If the one-time replay migration cannot re-insert a retained
+        vote (a wide vote advanced the span floor past a later vote's
+        source), the lost vote's target must be fenced off via
+        pruned_below — otherwise a second vote at that target with a
+        different root would pass the double-vote check (slashable)."""
+        p = SlashingProtection(MemoryDb(), max_epoch_lookback=64)
+        p.atts.put(
+            PK,
+            {
+                "targets": {
+                    # wide vote: replaying it advances the floor to 136
+                    "200": {"source": 0, "root": "aa" * 32},
+                    # source 100 < 136 → fails replay, would be lost
+                    "210": {"source": 100, "root": "bb" * 32},
+                },
+                "max_target": 210,
+                "min_source": 0,
+            },
+        )
+        # benign new vote triggers the migration
+        p.check_and_insert_attestation(PK, 211, 212, b"\x01" * 32)
+        # signing again at the lost target with a DIFFERENT root must be
+        # refused — history there is unknown, not absent
+        with pytest.raises(SlashingError):
+            p.check_and_insert_attestation(PK, 150, 210, b"\x02" * 32)
+
     def test_span_property_random(self, protection):
         """Property test: the span answers must equal the brute-force
         surround scan over the FULL vote history (never pruned here)."""
