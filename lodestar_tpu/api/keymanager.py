@@ -148,18 +148,21 @@ def create_keymanager_server(store, host: str = "127.0.0.1", port: int = 0,
         import secrets as _secrets
 
         bearer_token = "api-token-0x" + _secrets.token_hex(16)
-        if token_file is None:
-            # a generated token nobody can read makes the API unusable,
-            # but logging the secret itself would persist a live
-            # credential in log history — so persist it the way the
-            # reference does (api-token.txt, owner-only) and log only
-            # the path.
-            token_file = "api-token.txt"
         from ..utils.logger import get_logger
 
-        get_logger("keymanager").info(
-            "generated keymanager bearer token; written to %s", token_file
-        )
+        if token_file is not None:
+            get_logger("keymanager").info(
+                "generated keymanager bearer token; written to %s", token_file
+            )
+        else:
+            # never log the secret itself (it would persist a live
+            # credential in log history) and never write files the
+            # caller didn't ask for — point the operator at the handle
+            get_logger("keymanager").warning(
+                "generated keymanager bearer token but no token_file was "
+                "given: pass token_file to persist it (the CLI wires "
+                "<datadir>.api-token.txt); available as server.bearer_token"
+            )
     if token_file is not None:
         import os
 
@@ -173,4 +176,5 @@ def create_keymanager_server(store, host: str = "127.0.0.1", port: int = 0,
         bearer_token=bearer_token,
     )
     server.bearer_token = bearer_token
+    server.token_file = token_file
     return server

@@ -53,30 +53,32 @@ def _verify_now(verifier, sets) -> bool:
     supports it (block/segment import must not wait out a gossip
     batching window).
 
-    Support is detected ONCE per verifier type from the signature — not
-    by catching TypeError around the live call, which would swallow a
-    genuine TypeError raised inside verification (malformed set
-    contents) and silently re-run the whole batch."""
-    cls = type(verifier)
-    supports = _VERIFY_NOW_SUPPORT.get(cls)
+    Support is detected from the signature (cached per underlying
+    function, so instance-attribute overrides can't poison other
+    instances of the class) — not by catching TypeError around the live
+    call, which would swallow a genuine TypeError raised inside
+    verification (malformed set contents) and silently re-run the whole
+    batch. Only an explicit `batchable` parameter counts: every facade
+    in this repo declares it explicitly (chain/bls_verifier.py)."""
+    fn = verifier.verify_signature_sets
+    key = getattr(fn, "__func__", fn)
+    supports = _VERIFY_NOW_SUPPORT.get(key)
     if supports is None:
         import inspect
 
         try:
-            sig = inspect.signature(verifier.verify_signature_sets)
-            supports = "batchable" in sig.parameters or any(
-                p.kind is inspect.Parameter.VAR_KEYWORD
-                for p in sig.parameters.values()
-            )
+            supports = "batchable" in inspect.signature(fn).parameters
         except (ValueError, TypeError):  # builtins without signatures
             supports = False
-        _VERIFY_NOW_SUPPORT[cls] = supports
+        _VERIFY_NOW_SUPPORT[key] = supports
     if supports:
-        return verifier.verify_signature_sets(sets, batchable=False)
-    return verifier.verify_signature_sets(sets)
+        return fn(sets, batchable=False)
+    return fn(sets)
 
 
-_VERIFY_NOW_SUPPORT: dict = {}
+import weakref
+
+_VERIFY_NOW_SUPPORT: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 class BlockImportError(ValueError):

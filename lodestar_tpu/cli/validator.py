@@ -91,7 +91,7 @@ def run_validator(args) -> int:
         log.info(
             "keymanager API on port %d (token file: %s)",
             keymanager_server.port,
-            token_file or "api-token.txt",
+            keymanager_server.token_file,
         )
 
     try:

@@ -18,10 +18,11 @@ from ..network.reqresp import (
 class IPeer(Protocol):
     """A sync-usable remote peer.
 
-    Implementations are NOT required to be thread-safe: callers that
-    issue requests from multiple threads (e.g. RangeSync's download
-    window) must serialize access per peer — a transport multiplexing
-    one stream per peer would otherwise interleave request frames."""
+    Implementations MUST tolerate concurrent request calls (serialize
+    internally, as LocalPeer does): RangeSync's download window and
+    BackfillSync may both issue requests to the same peer from
+    different threads, and a transport multiplexing one stream per
+    peer would otherwise interleave request frames."""
 
     peer_id: str
 
@@ -35,27 +36,37 @@ class PeerError(Exception):
 
 
 class LocalPeer:
-    """A peer backed by another node's ReqRespHandlers (same process)."""
+    """A peer backed by another node's ReqRespHandlers (same process).
+
+    Requests serialize on an internal lock — the IPeer contract — so
+    RangeSync's download window and BackfillSync can hit the same peer
+    from different threads without interleaving."""
 
     def __init__(self, peer_id: str, handlers, types):
+        import threading
+
         self.peer_id = peer_id
         self.handlers = handlers
         self.types = types
+        self._lock = threading.Lock()
 
     def status(self):
-        wire = self.handlers.on_status(None)
+        with self._lock:
+            wire = self.handlers.on_status(None)
         chunks = decode_response_chunks(wire)
         self._check(chunks)
         return self.types.Status.deserialize(chunks[0][1])
 
     def beacon_blocks_by_range(self, start_slot: int, count: int) -> list:
-        wire = self.handlers.on_beacon_blocks_by_range(start_slot, count)
+        with self._lock:
+            wire = self.handlers.on_beacon_blocks_by_range(start_slot, count)
         chunks = decode_response_chunks(wire)
         self._check(chunks)
         return [self.types.SignedBeaconBlock.deserialize(p) for _, p in chunks]
 
     def beacon_blocks_by_root(self, roots: list[bytes]) -> list:
-        wire = self.handlers.on_beacon_blocks_by_root(roots)
+        with self._lock:
+            wire = self.handlers.on_beacon_blocks_by_root(roots)
         chunks = decode_response_chunks(wire)
         self._check(chunks)
         return [self.types.SignedBeaconBlock.deserialize(p) for _, p in chunks]

@@ -66,24 +66,6 @@ class RangeSync:
         self.metrics = metrics
         self.download_window = max(1, download_window)
         self.epochs_per_batch = max(1, epochs_per_batch)
-        # IPeer implementations are not required to be thread-safe, and
-        # the download window may assign the same peer to two in-flight
-        # batches once it exceeds the peer count — serialize per peer so
-        # a transport multiplexing one stream per peer never interleaves
-        # requests.
-        import threading as _threading
-
-        self._peer_locks: dict[str, _threading.Lock] = {}
-        self._peer_locks_guard = _threading.Lock()
-
-    def _peer_lock(self, peer_id: str):
-        with self._peer_locks_guard:
-            lock = self._peer_locks.get(peer_id)
-            if lock is None:
-                import threading as _threading
-
-                lock = self._peer_locks[peer_id] = _threading.Lock()
-            return lock
 
     def _export_batch_states(self, batches) -> None:
         if self.metrics is None:
@@ -162,10 +144,11 @@ class RangeSync:
             peer = self._pick_peer(batch)
             batch.status = BatchStatus.DOWNLOADING
             try:
-                with self._peer_lock(peer.peer_id):
-                    batch.blocks = peer.beacon_blocks_by_range(
-                        batch.start_slot, batch.count
-                    )
+                # concurrent window batches may land on the same peer;
+                # IPeer implementations serialize requests internally
+                batch.blocks = peer.beacon_blocks_by_range(
+                    batch.start_slot, batch.count
+                )
                 batch.status = BatchStatus.AWAITING_PROCESSING
                 return
             except PeerError:

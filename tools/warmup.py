@@ -1,0 +1,135 @@
+"""Compile-cache warm-up + size-bounded GC for `.jax_cache`.
+
+Deep pairing kernels compile in minutes (CPU backend: 7-13 min for the
+sharded grouped kernel); a cold cache at the wrong moment costs a restart
+its first slots — or a driver dry-run its timeout (round-4 lesson:
+`MULTICHIP_r04.json` went red purely on a cold-cache compile). This tool
+makes warm-up an explicit, documented step:
+
+  python tools/warmup.py                 # production ladder, current platform
+  python tools/warmup.py --dryrun        # the driver's dryrun_multichip(8)
+                                         #   CPU-mesh shape (run after the
+                                         #   LAST kernel change of a round)
+  python tools/warmup.py --prune-gb 6    # GC the cache down to 6 GiB (LRU)
+
+The production ladder = every shape the buffered verifier can dispatch
+steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
+(16x8, 64x64) + the bench shapes when --bench is given. Reference analog:
+the reference avoids this class of problem by having no compile step at
+all (blst is AOT); on TPU the restart story is "run warmup.py once per
+binary/kernel revision" (docs/architecture.md §compile-cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+CACHE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache")
+)
+
+
+def prune_cache(limit_gb: float) -> None:
+    """Delete least-recently-used cache entries until the cache fits the
+    bound. XLA cache entries are independent files — deleting one only
+    costs a recompile of that one kernel."""
+    entries = []
+    total = 0
+    for name in os.listdir(CACHE_DIR):
+        path = os.path.join(CACHE_DIR, name)
+        if not os.path.isfile(path):
+            continue
+        st = os.stat(path)
+        # atime tracks cache hits where the fs records it; fall back on mtime
+        entries.append((max(st.st_atime, st.st_mtime), st.st_size, path))
+        total += st.st_size
+    limit = int(limit_gb * (1 << 30))
+    print(f"cache: {len(entries)} entries, {total / (1 << 30):.2f} GiB "
+          f"(bound {limit_gb} GiB)")
+    if total <= limit:
+        return
+    removed = 0
+    for _, size, path in sorted(entries):
+        os.unlink(path)
+        total -= size
+        removed += 1
+        if total <= limit:
+            break
+    print(f"pruned {removed} entries -> {total / (1 << 30):.2f} GiB")
+
+
+def warm_production(include_bench: bool) -> None:
+    """Compile the production dispatch ladder on the current platform
+    (TPU when available — run this at deploy; each shape is one cached
+    XLA executable)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+
+    from __graft_entry__ import _example_arrays, _example_grouped
+    from lodestar_tpu.parallel.verifier import BatchVerifier, SetArrays, _rand_pairs
+
+    buckets = (4, 16, 64, 128) + ((4096,) if include_bench else ())
+    grouped = ((16, 8), (64, 64)) + (
+        ((64, 256), (64, 512)) if include_bench else ()
+    )
+    bv = BatchVerifier(buckets=buckets, grouped_configs=grouped)
+    for b in buckets:
+        arrs = SetArrays(b)
+        (arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+         arrs.sig_x, arrs.sig_y, r_bits, arrs.valid) = _example_arrays(b)
+        arrs.n = b
+        t0 = time.monotonic()
+        ok = bool(bv.verify_batch(arrs, r_bits))
+        print(f"per-set bucket {b}: {time.monotonic() - t0:.1f}s verdict={ok}",
+              flush=True)
+        t0 = time.monotonic()
+        ok = bv.verify_individual(arrs)
+        jax.block_until_ready(ok)
+        print(f"individual bucket {b}: {time.monotonic() - t0:.1f}s", flush=True)
+    for rows, lanes in grouped:
+        g, a_bits, b_bits = _example_grouped(rows, lanes)
+        t0 = time.monotonic()
+        ok = bool(bv.verify_grouped(g, a_bits, b_bits))
+        print(f"grouped {rows}x{lanes}: {time.monotonic() - t0:.1f}s "
+              f"verdict={ok}", flush=True)
+
+
+def warm_dryrun(n: int) -> None:
+    """Warm the exact shape the driver's multichip dry-run compiles (the
+    round-4 red-signal failure mode). Must run in a fresh process that
+    hasn't touched jax yet — re-exec if a backend already initialized."""
+    import __graft_entry__
+
+    t0 = time.monotonic()
+    __graft_entry__.dryrun_multichip(n)
+    print(f"dryrun_multichip({n}) warm in {time.monotonic() - t0:.1f}s")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dryrun", action="store_true",
+                    help="warm the driver's CPU-mesh dryrun shape instead")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="mesh size for --dryrun")
+    ap.add_argument("--bench", action="store_true",
+                    help="also warm the bench shapes (4096-set, 64x256/512)")
+    ap.add_argument("--prune-gb", type=float, default=None,
+                    help="GC the cache to this many GiB (LRU) and exit")
+    args = ap.parse_args()
+    if args.prune_gb is not None:
+        prune_cache(args.prune_gb)
+        return
+    if args.dryrun:
+        warm_dryrun(args.devices)
+        return
+    warm_production(args.bench)
+
+
+if __name__ == "__main__":
+    main()
