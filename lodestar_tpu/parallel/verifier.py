@@ -40,6 +40,7 @@ import numpy as np
 from ..bls import api as bls_api
 from ..bls.hash_to_curve import hash_to_g2
 from ..ops import fp, fp2, fp12, msm
+from ..ops.g2_decompress import decompress as _g2_decompress, planes_in_subgroup as _planes_in_subgroup
 from ..ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
 from ..ops.pairing import (
     final_exponentiation,
@@ -94,6 +95,33 @@ def _g2_sum_tree(ps):
 
 
 def batch_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
+    return _batch_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid,
+        check_planes=False,
+    )
+
+
+def batch_verify_kernel_raw(pk_x, pk_y, msg_x, msg_y, sig_raw, r_bits, valid):
+    """`batch_verify_kernel` taking RAW 96-byte compressed signatures.
+
+    Device-side decompression + batched plane subgroup check
+    (`ops/g2_decompress` — VERDICT r4 #5): the host's only signature work
+    is a memcpy. Any valid lane whose signature fails decoding (bad
+    flags, off-curve, infinity) makes the verdict False — matching the
+    host-marshal path, where `_native_limbs` returns None and the caller
+    reports False."""
+    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    decode_fail = jnp.any(valid & ~dec_ok)
+    verdict = _batch_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits,
+        valid & dec_ok, check_planes=True,
+    )
+    return verdict & ~decode_fail
+
+
+def _batch_verify_impl(
+    pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid, check_planes
+):
     """All-or-nothing batch verification; shapes (N, …) static.
 
     pk_*  (N, 32)     G1 affine Montgomery limbs (pre-aggregated pubkeys)
@@ -142,11 +170,41 @@ def batch_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
 
     fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((n + R_BITS,)))
-    return fp12.is_one(final_exponentiation(_fp12_product_tree(fs)))
+    verdict = fp12.is_one(final_exponentiation(_fp12_product_tree(fs)))
+    if check_planes:
+        # signature subgroup membership, batched: ψ(U_b) == [x]U_b on the
+        # 64 random bit-planes (2^-63 even with the forced-nonzero bit —
+        # soundness analysis in ops/g2_decompress.py)
+        verdict = verdict & _planes_in_subgroup(u_planes)
+    return verdict
 
 
 def grouped_verify_kernel(
     pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid
+):
+    return _grouped_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid,
+        check_planes=False,
+    )
+
+
+def grouped_verify_kernel_raw(
+    pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid
+):
+    """`grouped_verify_kernel` taking RAW 96-byte compressed signatures
+    (R, L, 96) — device decompression + plane subgroup checks, same
+    contract as `batch_verify_kernel_raw`."""
+    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    decode_fail = jnp.any(valid & ~dec_ok)
+    verdict = _grouped_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits,
+        valid & dec_ok, check_planes=True,
+    )
+    return verdict & ~decode_fail
+
+
+def _grouped_verify_impl(
+    pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid, check_planes
 ):
     """Batch verification GROUPED by signing root — the gossip-shape fast
     path (round-3 perf centerpiece; VERDICT r2 Missing #1).
@@ -229,7 +287,12 @@ def grouped_verify_kernel(
     lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
     fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((2 * R + 2 * HALF_BITS,)))
-    return fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    verdict = fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    if check_planes:
+        # u_planes BEFORE the ψ split: 64 iid random-bit planes of the
+        # signature lanes (soundness analysis in ops/g2_decompress.py)
+        verdict = verdict & _planes_in_subgroup(u_planes)
+    return verdict
 
 
 def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
@@ -381,6 +444,8 @@ class BatchVerifier:
         self._batch = jax.jit(batch_verify_kernel)
         self._individual = jax.jit(individual_verify_kernel)
         self._grouped = jax.jit(grouped_verify_kernel)
+        self._batch_raw = jax.jit(batch_verify_kernel_raw)
+        self._grouped_raw = jax.jit(grouped_verify_kernel_raw)
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -397,6 +462,22 @@ class BatchVerifier:
     def verify_grouped(self, g: GroupedArrays, a_bits, b_bits):
         return self._grouped(
             g.pk_x, g.pk_y, g.msg_x, g.msg_y, g.sig_x, g.sig_y,
+            a_bits, b_bits, g.valid,
+        )
+
+    def verify_batch_raw(self, arrs: SetArrays, sig_raw, r_bits):
+        """Per-set kernel with on-device signature decompression;
+        `sig_raw` (N, 96) uint8, `arrs.sig_*` ignored."""
+        return self._batch_raw(
+            arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+            sig_raw, r_bits, arrs.valid,
+        )
+
+    def verify_grouped_raw(self, g: GroupedArrays, sig_raw, a_bits, b_bits):
+        """Grouped kernel with on-device signature decompression;
+        `sig_raw` (R, L, 96) uint8, `g.sig_*` ignored."""
+        return self._grouped_raw(
+            g.pk_x, g.pk_y, g.msg_x, g.msg_y, sig_raw,
             a_bits, b_bits, g.valid,
         )
 
@@ -424,6 +505,7 @@ class TpuBlsVerifier:
         buckets: tuple[int, ...] = (4, 16, 64, 128),
         rng=None,
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
+        device_decompress: bool | None = None,
     ):
         self.kernels = BatchVerifier(buckets, grouped_configs)
         self._custom_rng = rng
@@ -456,6 +538,18 @@ class TpuBlsVerifier:
             __import__("os").environ.get("LODESTAR_TPU_PK_CACHE_MAX", 1 << 21)
         )
         self._pk_lock = threading.Lock()
+        # On-device signature decompression + batched plane subgroup
+        # checks (ops/g2_decompress): removes the ~0.6 ms/set C-tier
+        # signature marshal — the e2e floor on few-core hosts (VERDICT
+        # r4 #5). Costs two Fp pow chains per lane on device; hosts with
+        # cores to spare can keep the C tier. Constructor arg wins, then
+        # LODESTAR_TPU_DEVICE_DECOMPRESS=1.
+        if device_decompress is None:
+            device_decompress = (
+                __import__("os").environ.get("LODESTAR_TPU_DEVICE_DECOMPRESS")
+                == "1"
+            )
+        self._device_decompress = bool(device_decompress)
 
     # -- host marshalling ---------------------------------------------------
 
@@ -622,14 +716,28 @@ class TpuBlsVerifier:
                 return rows_cap, lane_cap, runs
         return None
 
-    def _marshal_grouped(self, sets, plan) -> GroupedArrays | None:
+    def _marshal_grouped(self, sets, plan, raw: bool = False):
         """Scatter sets into (rows × lanes) by signing root; None if any
-        set is invalid (the caller reports False, same as `_marshal`)."""
+        set is invalid (the caller reports False, same as `_marshal`).
+
+        raw=False: C-tier signature decompression → GroupedArrays.
+        raw=True: signatures stay BYTES for the device decode path →
+        (GroupedArrays with sig_* zeroed, sig_raw (R, L, 96) uint8)."""
         rows_cap, lane_cap, runs = plan
-        limbs = self._native_limbs(sets)
-        if limbs is None:
-            return None
-        pk_x, pk_y, sig_x, sig_y = limbs
+        if raw:
+            pk_rows = self._pk_rows(sets)
+            if pk_rows is None:
+                return None
+            pk_x, pk_y = pk_rows
+            sig_all = np.frombuffer(
+                b"".join(s.signature for s in sets), np.uint8
+            ).reshape(len(sets), 96)
+            sig_raw = np.zeros((rows_cap, lane_cap, 96), np.uint8)
+        else:
+            limbs = self._native_limbs(sets)
+            if limbs is None:
+                return None
+            pk_x, pk_y, sig_x, sig_y = limbs
         g = GroupedArrays(rows_cap, lane_cap)
         for row, run in enumerate(runs):
             hit = self._hash_root(sets[run[0]].message)
@@ -639,12 +747,15 @@ class TpuBlsVerifier:
             idx = np.asarray(run)
             k = len(run)
             g.pk_x[row, :k], g.pk_y[row, :k] = pk_x[idx], pk_y[idx]
-            g.sig_x[row, :k], g.sig_y[row, :k] = sig_x[idx], sig_y[idx]
+            if raw:
+                sig_raw[row, :k] = sig_all[idx]
+            else:
+                g.sig_x[row, :k], g.sig_y[row, :k] = sig_x[idx], sig_y[idx]
             g.valid[row, :k] = True
         g.n = len(sets)
-        return g
+        return (g, sig_raw) if raw else g
 
-    def _marshal(self, sets) -> SetArrays | None:
+    def _marshal(self, sets, raw: bool = False):
         """Build padded device arrays; None if any set is invalid up front.
 
         Fast path: the native C tier (`native/src/bls12.c`) decompresses,
@@ -652,12 +763,36 @@ class TpuBlsVerifier:
         the reference keeps exactly this preprocessing in blst C
         (multithread/worker.ts:33-55). Falls back to the big-int oracle
         when the extension is unavailable.
+
+        raw=True: signatures stay BYTES for the device decode path →
+        (SetArrays with sig_* zeroed, sig_raw (lanes, 96) uint8).
         """
         if not sets:
             return None
         lanes = self.kernels.bucket_for(len(sets))
         if len(sets) > lanes:
             return None  # caller must chunk (service layer's job)
+
+        if raw:
+            pk_rows = self._pk_rows(sets)
+            if pk_rows is None:
+                return None
+            pk_x, pk_y = pk_rows
+            arrs = SetArrays(lanes)
+            sig_raw = np.zeros((lanes, 96), np.uint8)
+            n = len(sets)
+            arrs.pk_x[:n], arrs.pk_y[:n] = pk_x, pk_y
+            sig_raw[:n] = np.frombuffer(
+                b"".join(s.signature for s in sets), np.uint8
+            ).reshape(n, 96)
+            for i, s in enumerate(sets):
+                hit = self._hash_root(s.message)
+                if hit is None:
+                    return None
+                arrs.msg_x[i], arrs.msg_y[i] = hit
+            arrs.valid[:n] = True
+            arrs.n = n
+            return arrs, sig_raw
 
         if self._native_eligible(sets):
             limbs = self._native_limbs(sets)
@@ -711,11 +846,9 @@ class TpuBlsVerifier:
         if sets and self._native_eligible(sets):
             plan = self._plan_groups(sets)
             if plan is not None:
-                g = self._marshal_grouped(sets, plan)
-                if g is None:
+                result = self._submit_grouped(sets, plan)
+                if result is None:
                     return lambda: False
-                a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
-                result = self.kernels.verify_grouped(g, a_bits, b_bits)
                 return lambda: bool(result)
             # mixed batch: peel the shared-root sets onto the grouped
             # kernel and leave only the singletons for the per-set kernel
@@ -724,27 +857,49 @@ class TpuBlsVerifier:
                 shared_sets = [sets[i] for i in shared]
                 sub_plan = self._plan_groups(shared_sets)
                 if sub_plan is not None:
-                    g = self._marshal_grouped(shared_sets, sub_plan)
-                    if g is None:
+                    grouped_res = self._submit_grouped(shared_sets, sub_plan)
+                    if grouped_res is None:
                         return lambda: False
-                    a_bits, b_bits = _rand_pairs(
-                        g.valid.shape, self._custom_rng
-                    )
-                    grouped_res = self.kernels.verify_grouped(
-                        g, a_bits, b_bits
-                    )
                     flat = self._submit_flat([sets[i] for i in unique])
                     return lambda: bool(grouped_res) and flat()
         return self._submit_flat(sets)
+
+    def _submit_grouped(self, sets, plan):
+        """Dispatch one grouped-kernel batch; None marks an invalid set
+        (caller reports False)."""
+        if self._device_decompress:
+            marshalled = self._marshal_grouped(sets, plan, raw=True)
+            if marshalled is None:
+                return None
+            g, sig_raw = marshalled
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            return self.kernels.verify_grouped_raw(g, sig_raw, a_bits, b_bits)
+        g = self._marshal_grouped(sets, plan)
+        if g is None:
+            return None
+        a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        return self.kernels.verify_grouped(g, a_bits, b_bits)
 
     def _submit_flat(self, sets):
         """Per-set kernel dispatch (chunked to the largest bucket);
         resolver ANDs the chunk verdicts — all-or-nothing, same as one
         dispatch."""
         cap = self.kernels.buckets[-1]
+        use_raw = self._device_decompress and self._native_eligible(sets)
         results = []
         for lo in range(0, max(len(sets), 1), cap):
-            arrs = self._marshal(sets[lo : lo + cap])
+            chunk = sets[lo : lo + cap]
+            if use_raw:
+                marshalled = self._marshal(chunk, raw=True)
+                if marshalled is None:
+                    return lambda: False
+                arrs, sig_raw = marshalled
+                r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
+                results.append(
+                    self.kernels.verify_batch_raw(arrs, sig_raw, r_bits)
+                )
+                continue
+            arrs = self._marshal(chunk)
             if arrs is None:
                 return lambda: False
             r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)

@@ -263,3 +263,77 @@ def test_pubkey_cache_hits_and_verdict_stable(grouped_verifier):
         signature=wrong.sign(sets[0].message).to_bytes(),
     )
     assert grouped_verifier.verify_signature_sets(sets) is False
+
+
+# --- device-decompression path (raw signature bytes on device) ---------------
+
+
+@pytest.fixture(scope="module")
+def raw_verifier():
+    return TpuBlsVerifier(
+        buckets=(4, 8), grouped_configs=((4, 4),), rng=_det_rng,
+        device_decompress=True,
+    )
+
+
+def test_raw_path_flat_valid_and_tampered(raw_verifier):
+    sets = _make_sets(3)
+    assert raw_verifier.verify_signature_sets(sets) is True
+    wrong = bls.interop_secret_key(77)
+    sets[1] = bls.SignatureSet(
+        pubkey=sets[1].pubkey,
+        message=sets[1].message,
+        signature=wrong.sign(sets[1].message).to_bytes(),
+    )
+    assert raw_verifier.verify_signature_sets(sets) is False
+
+
+def test_raw_path_rejects_non_subgroup_signature(raw_verifier):
+    """The C tier catches out-of-subgroup signatures at marshal time; the
+    device path must catch them via the batched plane check."""
+    from lodestar_tpu.bls.curve import B2, PointG2, g2_to_bytes
+    from lodestar_tpu.bls.fields import Fq2
+
+    x = Fq2.from_ints(5, 1)
+    while True:
+        y2 = x * x * x + B2
+        y = y2.sqrt()
+        if y is not None:
+            pt = PointG2(x, y, Fq2.one())
+            if not pt.is_in_subgroup():
+                break
+        x = x + Fq2.from_ints(1, 0)
+    sets = _make_sets(3)
+    sets[2] = bls.SignatureSet(
+        pubkey=sets[2].pubkey,
+        message=sets[2].message,
+        signature=g2_to_bytes(pt),
+    )
+    assert raw_verifier.verify_signature_sets(sets) is False
+
+
+def test_raw_path_rejects_infinity_and_malformed(raw_verifier):
+    sets = _make_sets(3)
+    sets[0] = bls.SignatureSet(
+        pubkey=sets[0].pubkey,
+        message=sets[0].message,
+        signature=bytes([0xC0]) + b"\x00" * 95,
+    )
+    assert raw_verifier.verify_signature_sets(sets) is False
+    sets = _make_sets(3)
+    sets[1] = bls.SignatureSet(
+        pubkey=sets[1].pubkey, message=sets[1].message, signature=b"\x01" * 96
+    )
+    assert raw_verifier.verify_signature_sets(sets) is False
+
+
+def test_raw_path_grouped_shared_roots(raw_verifier):
+    sets = _make_shared_root_sets(12, 2, salt=20)
+    assert raw_verifier.verify_signature_sets(sets) is True
+    wrong = bls.interop_secret_key(996)
+    sets[7] = bls.SignatureSet(
+        pubkey=sets[7].pubkey,
+        message=sets[7].message,
+        signature=wrong.sign(sets[7].message).to_bytes(),
+    )
+    assert raw_verifier.verify_signature_sets(sets) is False
