@@ -28,6 +28,8 @@ tests/test_ops_fp.py).
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -193,6 +195,82 @@ def _cond_sub(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _cond_sub_cols(a + b, _TWO_P)
+
+
+# exported bias for `reduce_sums` subtraction expressions (a − b + TWO_P)
+TWO_P = _TWO_P
+
+
+def reduce_sums(cols: jnp.ndarray) -> jnp.ndarray:
+    """Canonical [0, 2p) limbs from SIGNED column expressions with VALUE
+    in [0, 4p) — the add-side analog of the stacked-multiply discipline.
+
+    Formula code stacks a whole stage's independent adds/subs as raw
+    column arithmetic (`a + b`, `a - b + fp.TWO_P`, limbs stay within
+    int32 trivially) and pays ONE shared carry scan for all of them,
+    instead of one `fp.add` scan per value. Expressions must keep their
+    value under 4p (one conditional 2p-subtract restores the invariant):
+    chain a second reduce_sums level for deeper sums like 3t0 or 8y²."""
+    return _cond_sub_cols(cols, _TWO_P)
+
+
+class Sum:
+    """Trace-time bounds-tracked column expression (the deep-combine form
+    of the stacked-add discipline).
+
+    Wraps signed limb columns whose VALUE lies in [lo, hi) — bounds in
+    units of 2p, tracked through +/− at trace time. `reduce_stack` turns
+    a whole list of such expressions (a tower combine, a line-function
+    stage) into canonical [0, 2p) limbs with ONE carry scan over all
+    candidates — replacing one scan per fp.add/sub. Column magnitudes
+    stay tiny (a handful of 12-bit limbs plus bias), so int32 is never
+    at risk; only the VALUE bounds need the bookkeeping this class
+    automates."""
+
+    __slots__ = ("cols", "lo", "hi")
+
+    def __init__(self, cols, lo, hi):
+        self.cols = cols
+        self.lo = lo
+        self.hi = hi
+
+    def __add__(self, o):
+        return Sum(self.cols + o.cols, self.lo + o.lo, self.hi + o.hi)
+
+    def __sub__(self, o):
+        return Sum(self.cols - o.cols, self.lo - o.hi, self.hi - o.lo)
+
+    def double(self):
+        return Sum(self.cols + self.cols, 2 * self.lo, 2 * self.hi)
+
+
+def wrap(cols) -> Sum:
+    """Canonical [0, 2p) limbs as a Sum (lo=0, hi=1 in 2p units)."""
+    return Sum(cols, 0, 1)
+
+
+def reduce_stack(sums: "list[Sum]") -> "list[jnp.ndarray]":
+    """Canonical [0, 2p) limbs for every Sum, ONE shared carry scan.
+
+    Each expression is biased by a shared multiple of 2p (≡ 0 mod p, so
+    values are unchanged mod p) to make it non-negative, then reduced by
+    selecting among k candidates v − i·2p in a single stacked scan —
+    the i-th candidate's final borrow says whether i·2p still fits."""
+    shape = jnp.broadcast_shapes(*(s.cols.shape for s in sums))
+    bias = max(0, -min(s.lo for s in sums))
+    hi = max(s.hi for s in sums) + bias
+    k = max(1, math.ceil(hi))  # value < k·2p after biasing
+    base = jnp.stack(
+        [jnp.broadcast_to(s.cols + bias * _TWO_P, shape) for s in sums]
+    )
+    cands = jnp.stack([base - i * _TWO_P for i in range(k)])
+    limbs, out = _carry_scan_out(cands)
+    # largest non-negative candidate via a fused where-chain (a gather
+    # here measurably slowed the latency-bound kernels)
+    res = limbs[0]
+    for i in range(1, k):
+        res = jnp.where((out[i] >= 0)[..., None], limbs[i], res)
+    return [res[i] for i in range(len(sums))]
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -42,28 +42,36 @@ def add(a, b):
 
 
 def mul(a, b):
-    """Karatsuba over w: c0 = v0 + v·v1, c1 = (a0+a1)(b0+b1) − v0 − v1."""
+    """Karatsuba over w: c0 = v0 + v·v1, c1 = (a0+a1)(b0+b1) − v0 − v1.
+
+    Both the operand sums and the interpolation run as single
+    bounds-tracked combine scans (fp.reduce_stack) — the add-side analog
+    of stacking the three Fp6 products into one multiply."""
     a, b = _bcast(a, b)
     a0, a1 = _split(a)
     b0, b1 = _split(b)
-    big_a = jnp.stack([a0, a1, fp6.add(a0, a1)], axis=0)
-    big_b = jnp.stack([b0, b1, fp6.add(b0, b1)], axis=0)
-    v = fp6.mul(big_a, big_b)
+    sa, sb = fp.reduce_sums(jnp.stack([a0 + a1, b0 + b1]))
+    v = fp6.mul(jnp.stack([a0, a1, sa], axis=0), jnp.stack([b0, b1, sb], axis=0))
     v0, v1, v01 = v[0], v[1], v[2]
-    c0 = fp6.add(v0, fp6.mul_by_v(v1))
-    c1 = fp6.sub(fp6.sub(v01, v0), v1)
+    W = fp.wrap
+    c0 = W(v0) + fp6.mul_by_v_s(W(v1))
+    c1 = W(v01) - W(v0) - W(v1)
+    c0, c1 = fp.reduce_stack([c0, c1])
     return _join(c0, c1)
 
 
 def square(a):
     """Complex squaring: c0 = (a0+a1)(a0+v·a1) − v0 − v·v0, c1 = 2v0."""
     a0, a1 = _split(a)
-    big_a = jnp.stack([a0, fp6.add(a0, a1)], axis=0)
-    big_b = jnp.stack([a1, fp6.add(a0, fp6.mul_by_v(a1))], axis=0)
-    v = fp6.mul(big_a, big_b)
+    W = fp.wrap
+    s0, s1 = fp.reduce_stack(
+        [W(a0) + W(a1), W(a0) + fp6.mul_by_v_s(W(a1))]
+    )
+    v = fp6.mul(jnp.stack([a0, s0], axis=0), jnp.stack([a1, s1], axis=0))
     v0, mixed = v[0], v[1]
-    c0 = fp6.sub(fp6.sub(mixed, v0), fp6.mul_by_v(v0))
-    c1 = fp6.add(v0, v0)
+    c0 = W(mixed) - W(v0) - fp6.mul_by_v_s(W(v0))
+    c1 = W(v0).double()
+    c0, c1 = fp.reduce_stack([c0, c1])
     return _join(c0, c1)
 
 
@@ -91,36 +99,36 @@ def cyclotomic_square(g):
     g0, g1 = _split(g)
     a, b, c = fp6._split(g0)
     d, e, f = fp6._split(g1)
-    lhs = jnp.stack(
-        [a, e, fp2.add(a, e), c, d, fp2.add(c, d), f, b, fp2.add(b, f)], axis=0
-    )
+    W = fp.wrap
+    sae, scd, sbf = fp.reduce_sums(jnp.stack([a + e, c + d, b + f]))
+    lhs = jnp.stack([a, e, sae, c, d, scd, f, b, sbf], axis=0)
     s = fp2.mul(lhs, lhs)
-    a2, e2, ae2, c2, d2, cd2, f2, b2, bf2 = (s[i] for i in range(9))
-    t6 = fp2.sub(fp2.sub(ae2, a2), e2)  # 2ae
-    t7 = fp2.sub(fp2.sub(cd2, c2), d2)  # 2cd
-    t8 = fp2.mul_by_xi(fp2.sub(fp2.sub(bf2, b2), f2))  # 2bf·ξ
-    t0 = fp2.add(fp2.mul_by_xi(e2), a2)
-    t2 = fp2.add(fp2.mul_by_xi(c2), d2)
-    t4 = fp2.add(fp2.mul_by_xi(f2), b2)
+    a2, e2, ae2, c2, d2, cd2, f2, b2, bf2 = (W(s[i]) for i in range(9))
+    t6 = ae2 - a2 - e2  # 2ae
+    t7 = cd2 - c2 - d2  # 2cd
+    t8 = fp2.xi_s(bf2 - b2 - f2)  # 2bf·ξ
+    t0 = fp2.xi_s(e2) + a2
+    t2 = fp2.xi_s(c2) + d2
+    t4 = fp2.xi_s(f2) + b2
 
     def three_t_minus_2x(t, x):
-        y = fp2.sub(t, x)
-        return fp2.add(fp2.add(y, y), t)
+        return t.double() + t - W(x).double()
 
     def three_t_plus_2x(t, x):
-        y = fp2.add(t, x)
-        return fp2.add(fp2.add(y, y), t)
+        return t.double() + t + W(x).double()
 
-    c0 = fp6._join(
+    # the whole output assembly is ONE bounds-tracked combine scan
+    c0 = fp6.join_s(
         three_t_minus_2x(t0, a),
         three_t_minus_2x(t2, b),
         three_t_minus_2x(t4, c),
     )
-    c1 = fp6._join(
+    c1 = fp6.join_s(
         three_t_plus_2x(t8, d),
         three_t_plus_2x(t6, e),
         three_t_plus_2x(t7, f),
     )
+    c0, c1 = fp.reduce_stack([c0, c1])
     return _join(c0, c1)
 
 
@@ -144,9 +152,10 @@ def mul_by_line(f, l0, l1, l2):
     f0, f1 = _split(f)
     f00, f01, f02 = fp6._split(f0)
     f10, f11, f12 = fp6._split(f1)
-    g = fp6.add(f0, f1)
-    g0, g1, g2 = fp6._split(g)
-    s = fp2.add(l1, l2)
+    W = fp.wrap
+    g0, g1, g2, s = fp.reduce_sums(
+        jnp.stack([f00 + f10, f01 + f11, f02 + f12, l1 + l2])
+    )
     lhs = jnp.stack(
         [f00, f02, f00, f01, f01, f02, f12, f10, f11, g0, g2, g0, g1, g1, g2],
         axis=0,
@@ -157,22 +166,23 @@ def mul_by_line(f, l0, l1, l2):
     )
     rhs = jnp.broadcast_to(rhs, lhs.shape)
     p = fp2.mul(lhs, rhs)
-    # t0 = f0·A over v-coords
-    t0 = fp6._join(
-        fp2.add(p[0], fp2.mul_by_xi(p[1])),  # f00·l0 + ξ f02·l1
-        fp2.add(p[2], p[3]),  # f00·l1 + f01·l0
-        fp2.add(p[4], p[5]),  # f01·l1 + f02·l0
+    # t0 = f0·A, t1 = f1·B (B = l2·v), t2 = (f0+f1)(A+B) — then the
+    # Karatsuba combine c0 = t0 + v·t1, c1 = t2 − t0 − t1, ALL as one
+    # bounds-tracked scan (round 4 paid ~12 separate add scans here)
+    t0 = fp6.join_s(
+        W(p[0]) + fp2.xi_s(W(p[1])),
+        W(p[2]) + W(p[3]),
+        W(p[4]) + W(p[5]),
     )
-    # t1 = f1·B = f1·(l2 v) = ξ f12 l2 + f10 l2 v + f11 l2 v²
-    t1 = fp6._join(fp2.mul_by_xi(p[6]), p[7], p[8])
-    # t2 = (f0+f1)(A+B), A+B = (l0, s, 0)
-    t2 = fp6._join(
-        fp2.add(p[9], fp2.mul_by_xi(p[10])),
-        fp2.add(p[11], p[12]),
-        fp2.add(p[13], p[14]),
+    t1 = fp6.join_s(fp2.xi_s(W(p[6])), W(p[7]), W(p[8]))
+    t2 = fp6.join_s(
+        W(p[9]) + fp2.xi_s(W(p[10])),
+        W(p[11]) + W(p[12]),
+        W(p[13]) + W(p[14]),
     )
-    c0 = fp6.add(t0, fp6.mul_by_v(t1))
-    c1 = fp6.sub(fp6.sub(t2, t0), t1)
+    c0 = t0 + fp6.mul_by_v_s(t1)
+    c1 = t2 - t0 - t1
+    c0, c1 = fp.reduce_stack([c0, c1])
     return _join(c0, c1)
 
 

@@ -33,6 +33,11 @@ def add(a, b):
     return fp.add(a, b)  # fp ops are elementwise over all leading axes
 
 
+# stacked-add discipline: elementwise over the (2, 32) coord block too
+reduce_sums = fp.reduce_sums
+TWO_P = fp.TWO_P
+
+
 def sub(a, b):
     return fp.sub(a, b)
 
@@ -134,6 +139,15 @@ def mul_by_xi(a):
     """Multiply by the Fp6 non-residue ξ = 1 + u: (c0 − c1) + (c0 + c1)u."""
     a0, a1 = _split(a)
     return _join(fp.sub(a0, a1), fp.add(a0, a1))
+
+
+def xi_s(s: "fp.Sum") -> "fp.Sum":
+    """ξ·(expression) on a bounds-tracked Sum over an (…, 2, 32) block
+    (see fp.Sum / fp.reduce_stack — the deep-combine add discipline)."""
+    c0 = s.cols[..., 0, :]
+    c1 = s.cols[..., 1, :]
+    cols = jnp.stack([c0 - c1, c0 + c1], axis=-2)
+    return fp.Sum(cols, min(s.lo - s.hi, 2 * s.lo), max(s.hi - s.lo, 2 * s.hi))
 
 
 def conj(a):

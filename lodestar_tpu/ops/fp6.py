@@ -54,17 +54,20 @@ def mul(a, b):
     a, b = _bcast(a, b)
     a0, a1, a2 = _split(a)
     b0, b1, b2 = _split(b)
-    big_a = jnp.stack(
-        [a0, a1, a2, fp2.add(a1, a2), fp2.add(a0, a1), fp2.add(a0, a2)], axis=0
+    sa12, sa01, sa02, sb12, sb01, sb02 = fp.reduce_sums(
+        jnp.stack([a1 + a2, a0 + a1, a0 + a2, b1 + b2, b0 + b1, b0 + b2])
     )
-    big_b = jnp.stack(
-        [b0, b1, b2, fp2.add(b1, b2), fp2.add(b0, b1), fp2.add(b0, b2)], axis=0
-    )
+    big_a = jnp.stack([a0, a1, a2, sa12, sa01, sa02], axis=0)
+    big_b = jnp.stack([b0, b1, b2, sb12, sb01, sb02], axis=0)
     v = fp2.mul(big_a, big_b)
     v0, v1, v2, v12, v01, v02 = v[0], v[1], v[2], v[3], v[4], v[5]
-    c0 = fp2.add(v0, fp2.mul_by_xi(fp2.sub(fp2.sub(v12, v1), v2)))
-    c1 = fp2.add(fp2.sub(fp2.sub(v01, v0), v1), fp2.mul_by_xi(v2))
-    c2 = fp2.add(fp2.sub(fp2.sub(v02, v0), v2), v1)
+    # interpolation as ONE bounds-tracked combine scan (fp.reduce_stack)
+    # instead of ~11 sequential add/sub scans
+    W = fp.wrap
+    c0 = W(v0) + fp2.xi_s(W(v12) - W(v1) - W(v2))
+    c1 = W(v01) - W(v0) - W(v1) + fp2.xi_s(W(v2))
+    c2 = W(v02) - W(v0) - W(v2) + W(v1)
+    c0, c1, c2 = fp.reduce_stack([c0, c1, c2])
     return _join(c0, c1, c2)
 
 
@@ -76,6 +79,24 @@ def mul_by_v(a):
     """v·(c0 + c1v + c2v²) = ξc2 + c0·v + c1·v²."""
     a0, a1, a2 = _split(a)
     return _join(fp2.mul_by_xi(a2), a0, a1)
+
+
+def mul_by_v_s(s: "fp.Sum") -> "fp.Sum":
+    """`mul_by_v` on a bounds-tracked Sum over an (…, 3, 2, 32) block."""
+    x2 = fp.Sum(s.cols[..., 2, :, :], s.lo, s.hi)
+    xi2 = fp2.xi_s(x2)
+    cols = jnp.stack(
+        [xi2.cols, s.cols[..., 0, :, :], s.cols[..., 1, :, :]], axis=-3
+    )
+    return fp.Sum(cols, min(xi2.lo, s.lo), max(xi2.hi, s.hi))
+
+
+def join_s(s0: "fp.Sum", s1: "fp.Sum", s2: "fp.Sum") -> "fp.Sum":
+    """Stack three fp2-block Sums into one fp6-block Sum."""
+    cols = jnp.stack([s0.cols, s1.cols, s2.cols], axis=-3)
+    return fp.Sum(
+        cols, min(s0.lo, s1.lo, s2.lo), max(s0.hi, s1.hi, s2.hi)
+    )
 
 
 def mul_fp2(a, k):

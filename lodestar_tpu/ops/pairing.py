@@ -66,20 +66,24 @@ def _line_and_double(t, xp_neg2, yp2, zp2, b3):
     xp_neg2/yp2/zp2 are the G1 evaluation point lifted to Fp2 (zero
     imaginary part); zp2 is None for affine P."""
     x, y, z = t
+    W = fp.wrap
     # stage A: shared quadratic monomials
     xx, yy, zz, yz, xy = _stack_mul([x, y, z, y, x], [x, y, z, z, y])
     # stage B: cubics + the b3 scaling
     xxx, yyz, xxz, yzz, t2b = _stack_mul(
         [xx, yy, xx, yz, b3], [x, z, z, z, zz]
     )
-    l0 = fp2.sub(
-        fp2.add(fp2.add(xxx, xxx), xxx), fp2.double(yyz)
-    )  # 3X³ − 2Y²Z
-    three_xxz = fp2.add(fp2.add(xxz, xxz), xxz)
-    two_yzz = fp2.double(yzz)
-    z8 = fp2.double(fp2.double(fp2.double(yy)))  # 8Y²
-    y3s = fp2.add(yy, t2b)
-    t0c = fp2.sub(yy, fp2.add(fp2.add(t2b, t2b), t2b))
+    # combines grouped into TWO bounds-tracked scans by candidate count
+    # (round 4: ~12 sequential add scans)
+    y3s, two_yzz, three_xxz = fp.reduce_stack(
+        [W(yy) + W(t2b), W(yzz).double(),
+         W(xxz).double() + W(xxz)]
+    )
+    z8, l0, t0c = fp.reduce_stack(
+        [W(yy).double().double().double(),                      # 8Y²
+         W(xxx).double() + W(xxx) - W(yyz).double(),            # 3X³ − 2Y²Z
+         W(yy) - (W(t2b).double() + W(t2b))]
+    )
     # stage C: line evaluations + double outputs
     lhs = [three_xxz, two_yzz, t2b, yz, t0c, t0c]
     rhs = [xp_neg2, yp2, z8, z8, y3s, xy]
@@ -90,7 +94,8 @@ def _line_and_double(t, xp_neg2, yp2, zp2, b3):
     l1, l2, x3, z3, y3m, xt = out[:6]
     if zp2 is not None:
         l0 = out[6]
-    t_next = (fp2.double(xt), fp2.add(x3, y3m), z3)
+    ox, oy = fp.reduce_stack([W(xt).double(), W(x3) + W(y3m)])
+    t_next = (ox, oy, z3)
     return l0, l1, l2, t_next
 
 
@@ -107,24 +112,28 @@ def _line_and_add_projq(t, q_proj, xp_neg2, yp2, zp2, b3):
     Three stacked fp2 multiplies (8+6+9), mirroring the mixed variant."""
     x, y, z = t
     xq, yq, zq = q_proj
+    W = fp.wrap
+    sxy, sq = fp.reduce_sums(jnp.stack([x + y, xq + yq]))
     # stage A: RCB16 cross products + the four line cross terms
     t0, t1, t2, u, yzq, yqz, xzq, xqz = _stack_mul(
-        [x, y, z, fp2.add(x, y), y, yq, x, xq],
-        [xq, yq, zq, fp2.add(xq, yq), zq, z, zq, z],
+        [x, y, z, sxy, y, yq, x, xq],
+        [xq, yq, zq, sq, zq, z, zq, z],
     )
-    theta = fp2.sub(yzq, yqz)      # Zq·(Y − yq·Z)
-    h = fp2.sub(xzq, xqz)          # Zq·(X − xq·Z)
-    t3 = fp2.sub(u, fp2.add(t0, t1))
-    t4 = fp2.add(yzq, yqz)
-    y3p = fp2.add(xzq, xqz)
-    x3 = fp2.add(fp2.add(t0, t0), t0)
+    theta, h, t3, t4, y3p, x3 = fp.reduce_stack(
+        [W(yzq) - W(yqz),              # Zq·(Y − yq·Z)
+         W(xzq) - W(xqz),              # Zq·(X − xq·Z)
+         W(u) - W(t0) - W(t1),
+         W(yzq) + W(yqz),
+         W(xzq) + W(xqz),
+         W(t0).double() + W(t0)]
+    )
     # stage B: b3 scalings + line products
     t2b, th_xq, yq_h, thz, hz, y3 = _stack_mul(
         [b3, theta, yq, zq, zq, b3], [t2, xq, h, theta, h, y3p]
     )
-    l0 = fp2.sub(th_xq, yq_h)
-    z3 = fp2.add(t1, t2b)
-    t1m = fp2.sub(t1, t2b)
+    l0, z3, t1m = fp.reduce_stack(
+        [W(th_xq) - W(yq_h), W(t1) + W(t2b), W(t1) - W(t2b)]
+    )
     # stage C: addition outputs + the two line evaluations (+ optional l0·zp)
     lhs = [t3, t4, y3, t1m, z3, x3, thz, hz]
     rhs = [t1m, y3, x3, z3, t4, t3, xp_neg2, yp2]
@@ -135,8 +144,10 @@ def _line_and_add_projq(t, q_proj, xp_neg2, yp2, zp2, b3):
     a, b, c, d, e, f, l1, l2 = out[:8]
     if zp2 is not None:
         l0 = out[8]
-    t_next = (fp2.sub(a, b), fp2.add(c, d), fp2.add(e, f))
-    return l0, l1, l2, t_next
+    ox, oy, oz = fp.reduce_stack(
+        [W(a) - W(b), W(c) + W(d), W(e) + W(f)]
+    )
+    return l0, l1, l2, (ox, oy, oz)
 
 
 def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
@@ -148,18 +159,22 @@ def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
     multiplies (6+6+7) instead of ~9 sequential."""
     x, y, z = t
     xq, yq = q_aff
+    W = fp.wrap
+    sxy, sq = fp.reduce_sums(jnp.stack([x + y, xq + yq]))
     # stage A: line + addition cross products (xq·z / yq·z shared)
     t0, t1, u, xqz, yqz, b3z = _stack_mul(
-        [x, y, fp2.add(x, y), xq, yq, b3], [xq, yq, fp2.add(xq, yq), z, z, z]
+        [x, y, sxy, xq, yq, b3], [xq, yq, sq, z, z, z]
     )
-    theta = fp2.sub(y, yqz)
-    h = fp2.sub(x, xqz)
-    t3 = fp2.sub(u, fp2.add(t0, t1))
-    y3p = fp2.add(xqz, x)
-    t4 = fp2.add(yqz, y)
-    x3 = fp2.add(fp2.add(t0, t0), t0)
-    z3 = fp2.add(t1, b3z)
-    t1m = fp2.sub(t1, b3z)
+    theta, h, t3, y3p, t4, x3, z3, t1m = fp.reduce_stack(
+        [W(y) - W(yqz),
+         W(x) - W(xqz),
+         W(u) - W(t0) - W(t1),
+         W(xqz) + W(x),
+         W(yqz) + W(y),
+         W(t0).double() + W(t0),
+         W(t1) + W(b3z),
+         W(t1) - W(b3z)]
+    )
     # stage B: line products + the b3·y3p scaling
     th_xq, yq_h, l1, l2, y3 = _stack_mul(
         [theta, yq, theta, h, b3], [xq, h, xp_neg2, yp2, y3p]
@@ -175,8 +190,10 @@ def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
     a, b, c, d, e, f = out[:6]
     if zp2 is not None:
         l0 = out[6]
-    t_next = (fp2.sub(a, b), fp2.add(c, d), fp2.add(e, f))
-    return l0, l1, l2, t_next
+    ox, oy, oz = fp.reduce_stack(
+        [W(a) - W(b), W(c) + W(d), W(e) + W(f)]
+    )
+    return l0, l1, l2, (ox, oy, oz)
 
 
 def miller_loop(p_aff, q_aff):

@@ -88,29 +88,48 @@ class CurveOps:
         out = F.mul(jnp.stack(lhs, axis=0), jnp.stack(rhs, axis=0))
         return [out[i] for i in range(len(lhs))]
 
+    def _addstack(self, exprs):
+        """One stacked `F.reduce_sums` over a stage's independent add/sub
+        COLUMN expressions (each with value < 4p) — the add-side analog
+        of `_mulstack` (one carry scan instead of one per value)."""
+        shape = jnp.broadcast_shapes(*(e.shape for e in exprs))
+        out = self.F.reduce_sums(
+            jnp.stack([jnp.broadcast_to(e, shape) for e in exprs], axis=0)
+        )
+        return [out[i] for i in range(len(exprs))]
+
     def add(self, p, q):
-        """RCB16 Algorithm 7 (a=0): complete projective addition."""
+        """RCB16 Algorithm 7 (a=0): complete projective addition.
+
+        Stacked-scan discipline on BOTH op kinds: 3 stacked multiplies
+        (6+2+6 products) and 4 stacked add-scans — round 4 paid ~15
+        individual add scans on top of the multiplies."""
         F, b3 = self.F, self.b3
+        TP = F.TWO_P
         x1, y1, z1 = p
         x2, y2, z2 = q
+        xy1, yz1, xz1, xy2, yz2, xz2 = self._addstack(
+            [x1 + y1, y1 + z1, x1 + z1, x2 + y2, y2 + z2, x2 + z2]
+        )
         # stage A: all 6 cross products at once
         t0, t1, t2, u, v, w = self._mulstack(
-            [x1, y1, z1, F.add(x1, y1), F.add(y1, z1), F.add(x1, z1)],
-            [x2, y2, z2, F.add(x2, y2), F.add(y2, z2), F.add(x2, z2)],
+            [x1, y1, z1, xy1, yz1, xz1], [x2, y2, z2, xy2, yz2, xz2]
         )
-        t3 = F.sub(u, F.add(t0, t1))   # x1y2 + x2y1
-        t4 = F.sub(v, F.add(t1, t2))   # y1z2 + y2z1
-        y3p = F.sub(w, F.add(t0, t2))  # x1z2 + x2z1
-        x3 = F.add(F.add(t0, t0), t0)  # 3·x1x2
+        s01, s12, s02, t00 = self._addstack(
+            [t0 + t1, t1 + t2, t0 + t2, t0 + t0]
+        )
+        t3, t4, y3p, x3 = self._addstack(
+            [u - s01 + TP, v - s12 + TP, w - s02 + TP, t00 + t0]
+        )
         # stage B: the two b3 scalings
         t2b, y3 = self._mulstack([b3, b3], [t2, y3p])
-        z3 = F.add(t1, t2b)
-        t1 = F.sub(t1, t2b)
+        z3, t1 = self._addstack([t1 + t2b, t1 - t2b + TP])
         # stage C: the 6 output products
         a, b, c, d, e, f = self._mulstack(
             [t3, t4, y3, t1, z3, x3], [t1, y3, x3, z3, t4, t3]
         )
-        return (F.sub(a, b), F.add(c, d), F.add(e, f))
+        ox, oy, oz = self._addstack([a - b + TP, c + d, e + f])
+        return (ox, oy, oz)
 
     def add_mixed(self, p, q_affine):
         """RCB16 Algorithm 8 (a=0): complete mixed addition, Z2 = 1.
@@ -119,49 +138,46 @@ class CurveOps:
         degenerate inputs at the API layer.
         """
         F, b3 = self.F, self.b3
+        TP = F.TWO_P
         x1, y1, z1 = p
         x2, y2 = q_affine
+        xy1, xy2 = self._addstack([x1 + y1, x2 + y2])
         # stage A: cross products + the b3·z1 scaling are all independent
         t0, t1, u, xz, yz, t2b = self._mulstack(
-            [x1, y1, F.add(x1, y1), x2, y2, b3],
-            [x2, y2, F.add(x2, y2), z1, z1, z1],
+            [x1, y1, xy1, x2, y2, b3], [x2, y2, xy2, z1, z1, z1]
         )
-        t3 = F.sub(u, F.add(t0, t1))
-        y3p = F.add(xz, x1)            # x1 + x2·z1
-        t4 = F.add(yz, y1)             # y1 + y2·z1
-        x3 = F.add(F.add(t0, t0), t0)
-        z3 = F.add(t1, t2b)
-        t1 = F.sub(t1, t2b)
+        s01, t00, y3p, t4, z3, t1m = self._addstack(
+            [t0 + t1, t0 + t0, xz + x1, yz + y1, t1 + t2b, t1 - t2b + TP]
+        )
+        t3, x3 = self._addstack([u - s01 + TP, t00 + t0])
+        t1 = t1m
         # stage B: b3 scaling of y3p
         y3 = F.mul(b3, y3p)
         # stage C: outputs
         a, b, c, d, e, f = self._mulstack(
             [t3, t4, y3, t1, z3, x3], [t1, y3, x3, z3, t4, t3]
         )
-        return (F.sub(a, b), F.add(c, d), F.add(e, f))
+        ox, oy, oz = self._addstack([a - b + TP, c + d, e + f])
+        return (ox, oy, oz)
 
     def double(self, p):
         """RCB16 Algorithm 9 (a=0): complete projective doubling."""
         F, b3 = self.F, self.b3
+        TP = F.TWO_P
         x, y, z = p
         # stage A: the 4 independent squares/products
         t0, t1, t2, txy = self._mulstack([y, y, z, x], [y, z, z, y])
-        z8 = F.add(t0, t0)
-        z8 = F.add(z8, z8)
-        z8 = F.add(z8, z8)  # 8y²
         # stage B: b3·z²
         t2b = F.mul(b3, t2)
-        y3s = F.add(t0, t2b)
-        t1c = F.add(t2b, t2b)
-        t2c = F.add(t1c, t2b)
-        t0c = F.sub(t0, t2c)
+        z2d, y3s, t1c = self._addstack([t0 + t0, t0 + t2b, t2b + t2b])
+        z4d, t2c = self._addstack([z2d + z2d, t1c + t2b])
+        z8, t0c = self._addstack([z4d + z4d, t0 - t2c + TP])  # z8 = 8y²
         # stage C: the 4 output products
         x3, z3, y3, xt = self._mulstack(
             [t2b, t1, t0c, t0c], [z8, z8, y3s, txy]
         )
-        y3 = F.add(x3, y3)
-        xt = F.add(xt, xt)
-        return (xt, y3, z3)
+        oy, ox = self._addstack([x3 + y3, xt + xt])
+        return (ox, oy, z3)
 
     def neg(self, p):
         return (p[0], self.F.neg(p[1]), p[2])
