@@ -240,7 +240,16 @@ async def drive(handlers, chain, types, config, sks, n_committees: int) -> dict:
         for t in handlers.queues
         if handlers.queues[t].metrics.dropped_jobs
     }
+    # honest core-count extrapolation: mean busy seconds per slot over the
+    # slot budget (the ladder + marshal tier scale linearly with cores —
+    # the C tier releases the GIL; reference analog: poolSize.ts)
+    import math
+
+    mean_busy = sum(p["slot_busy_s"] for p in per_slot) / max(1, len(per_slot))
+    cores_needed = max(1, math.ceil(mean_busy / SLOT_SEC))
     return {
+        "cores_needed": cores_needed,
+        "mean_slot_busy_s": round(mean_busy, 2),
         "committees_per_slot": n_committees,
         "slots": SLOTS,
         "verified": verified,
