@@ -69,7 +69,9 @@ __all__ = [
     "TpuBlsVerifier",
     "SetArrays",
     "GroupedArrays",
+    "PkGroupedArrays",
     "grouped_verify_kernel",
+    "pk_grouped_verify_kernel",
 ]
 
 
@@ -295,6 +297,104 @@ def _grouped_verify_impl(
     return verdict
 
 
+def pk_grouped_verify_kernel(
+    pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid
+):
+    return _pk_grouped_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid,
+        check_planes=False,
+    )
+
+
+def pk_grouped_verify_kernel_raw(
+    pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid
+):
+    """`pk_grouped_verify_kernel` taking RAW 96-byte compressed signatures
+    (R, L, 96) — device decompression + plane subgroup checks."""
+    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    decode_fail = jnp.any(valid & ~dec_ok)
+    verdict = _pk_grouped_verify_impl(
+        pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits,
+        valid & dec_ok, check_planes=True,
+    )
+    return verdict & ~decode_fail
+
+
+def _pk_grouped_verify_impl(
+    pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid, check_planes
+):
+    """Batch verification GROUPED BY PUBKEY — the DUAL of the root-grouped
+    kernel, and the adversarial-floor defense (VERDICT r4 #2).
+
+    An attacker can mint arbitrarily many unique `AttestationData` roots
+    (defeating root-grouping), but every set still needs a VALID signature
+    — and the attacker only controls boundedly many validator keys. Sets
+    sharing a pubkey collapse by bilinearity on the OTHER side:
+
+        Π_k e(pk_k, Σ_{i∈k} r_i·H_i) · e(−g1, Σ_i r_i·sig_i) == 1
+
+    R pubkey-rows × L lanes run R+64 Miller loops instead of N+64. The
+    per-row message combination Σ r_i·H_i is a G2 bit-plane MSM (same
+    `ops/msm.py` machinery as the root-grouped pubkey sums, on the twist):
+    GLS-split randomness halves plane depth, ψ lands the b-half, and ONE
+    32-step Horner over (2, R) lanes recombines — the per-row result is a
+    single G2 point added to ψ(b-half), so each row is ONE pairing lane.
+    The signature aggregate rides the same constant-lane planes as every
+    other kernel. The residual true worst case — distinct pubkeys AND
+    distinct roots simultaneously — remains on the per-set kernel and is
+    reported honestly as its own bench row.
+
+    Shapes (static): pk_* (R, 32) — ONE pubkey per row; msg_* and sig_*
+    (R, L, 2, 32); a_bits/b_bits (R, L, 32) LSB-first; valid (R, L).
+    L % 4 == 0. Rows may repeat a pubkey (the planner splits >L-set
+    groups across rows). Returns scalar bool, all-or-nothing.
+
+    Reference analog: blst aggregates PUBKEYS per set for one shared
+    message (`chain/bls/utils.ts:5-16`); this is the transpose — messages
+    aggregated per pubkey — enabled by device-scale MSM.
+    """
+    R, L = msg_x.shape[0], msg_x.shape[1]
+    n = R * L
+    msgs = (msg_x, msg_y, fp2.one((R, L)))
+    msgs = g2.select(valid, msgs, g2.infinity((R, L)))
+    bits = jnp.concatenate([a_bits, b_bits], axis=-1)  # (R, L, 64)
+
+    # per-row message bit-plane sums: (64, R) G2 projective
+    m_planes = msm.masked_plane_sums(g2, msgs, bits)
+    tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in m_planes)
+    tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)  # (32, 2, R, …)
+    ab = msm.horner_pow2(g2, tp)  # (2, R) projective
+    a_pt = tuple(c[0] for c in ab)
+    b_pt = tuple(c[1] for c in ab)
+    q_row = g2.add(a_pt, g2_psi(b_pt))  # Σ r_i·H_i per row
+
+    # signature side: identical constant-lane planes as the other kernels
+    sig = (
+        sig_x.reshape((n,) + sig_x.shape[-2:]),
+        sig_y.reshape((n,) + sig_y.shape[-2:]),
+        fp2.one((n,)),
+    )
+    sig = g2.select(valid.reshape(n), sig, g2.infinity((n,)))
+    u_planes = msm.masked_plane_sums(g2, sig, bits.reshape(n, 2 * HALF_BITS))
+    u_a = tuple(c[:HALF_BITS] for c in u_planes)
+    u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
+
+    px = jnp.concatenate([pk_x, NEG_G1_POW2_X, NEG_G1_POW2_X], 0)
+    py = jnp.concatenate([pk_y, NEG_G1_POW2_Y, NEG_G1_POW2_Y], 0)
+    pz = jnp.concatenate([fp.one((R,)), fp.one((2 * HALF_BITS,))], 0)
+    qx = jnp.concatenate([q_row[0], u_a[0], u_b[0]], 0)
+    qy = jnp.concatenate([q_row[1], u_a[1], u_b[1]], 0)
+    qz = jnp.concatenate([q_row[2], u_a[2], u_b[2]], 0)
+
+    lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
+    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    fs = fp12.select(lane_ok, fs, fp12.one((R + 2 * HALF_BITS,)))
+    verdict = fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    if check_planes:
+        verdict = verdict & _planes_in_subgroup(u_planes)
+    return verdict
+
+
 def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
     """Per-set verdicts in one dispatch: e(pk_i, H(m_i))·e(−g1, sig_i) == 1.
 
@@ -382,6 +482,23 @@ class GroupedArrays:
         self.n = 0
 
 
+class PkGroupedArrays:
+    """Signature sets grouped by PUBKEY into (R rows × L lanes) — one
+    pubkey per row, per-lane messages/signatures (the dual layout)."""
+
+    __slots__ = ("pk_x", "pk_y", "msg_x", "msg_y", "sig_x", "sig_y", "valid", "n")
+
+    def __init__(self, rows: int, lanes: int):
+        self.pk_x = np.zeros((rows, N_LIMBS), np.int32)
+        self.pk_y = np.zeros((rows, N_LIMBS), np.int32)
+        self.msg_x = np.zeros((rows, lanes, 2, N_LIMBS), np.int32)
+        self.msg_y = np.zeros((rows, lanes, 2, N_LIMBS), np.int32)
+        self.sig_x = np.zeros((rows, lanes, 2, N_LIMBS), np.int32)
+        self.sig_y = np.zeros((rows, lanes, 2, N_LIMBS), np.int32)
+        self.valid = np.zeros((rows, lanes), bool)
+        self.n = 0
+
+
 def _rand_bits(lanes: int, rng) -> np.ndarray:
     """(lanes, 64) nonzero random scalar bits, MSB first."""
     out = np.zeros((lanes, R_BITS), np.int32)
@@ -428,12 +545,16 @@ class BatchVerifier:
         self,
         buckets: tuple[int, ...] = (4, 16, 64, 128),
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
+        pk_grouped_configs: tuple[tuple[int, int], ...] = ((128, 32),),
     ):
         self.buckets = tuple(sorted(buckets))
         self.grouped_configs = tuple(
             sorted(grouped_configs, key=lambda c: c[0] * c[1])
         )
-        for _, lanes in self.grouped_configs:
+        self.pk_grouped_configs = tuple(
+            sorted(pk_grouped_configs, key=lambda c: c[0] * c[1])
+        )
+        for _, lanes in self.grouped_configs + self.pk_grouped_configs:
             if lanes % 4 != 0:
                 raise ValueError("grouped lanes_per_row must be a multiple of 4")
         for b in self.buckets:
@@ -446,6 +567,8 @@ class BatchVerifier:
         self._grouped = jax.jit(grouped_verify_kernel)
         self._batch_raw = jax.jit(batch_verify_kernel_raw)
         self._grouped_raw = jax.jit(grouped_verify_kernel_raw)
+        self._pk_grouped = jax.jit(pk_grouped_verify_kernel)
+        self._pk_grouped_raw = jax.jit(pk_grouped_verify_kernel_raw)
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -481,6 +604,18 @@ class BatchVerifier:
             a_bits, b_bits, g.valid,
         )
 
+    def verify_pk_grouped(self, g: "PkGroupedArrays", a_bits, b_bits):
+        return self._pk_grouped(
+            g.pk_x, g.pk_y, g.msg_x, g.msg_y, g.sig_x, g.sig_y,
+            a_bits, b_bits, g.valid,
+        )
+
+    def verify_pk_grouped_raw(self, g: "PkGroupedArrays", sig_raw, a_bits, b_bits):
+        return self._pk_grouped_raw(
+            g.pk_x, g.pk_y, g.msg_x, g.msg_y, sig_raw,
+            a_bits, b_bits, g.valid,
+        )
+
     def verify_individual(self, arrs: SetArrays):
         return self._individual(
             arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
@@ -506,8 +641,9 @@ class TpuBlsVerifier:
         rng=None,
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
         device_decompress: bool | None = None,
+        pk_grouped_configs: tuple[tuple[int, int], ...] = ((128, 32),),
     ):
-        self.kernels = BatchVerifier(buckets, grouped_configs)
+        self.kernels = BatchVerifier(buckets, grouped_configs, pk_grouped_configs)
         self._custom_rng = rng
         self._rng = rng if rng is not None else (lambda: secrets.randbits(R_BITS))
         # hash-to-curve cache keyed by signing root: committee gossip
@@ -688,33 +824,42 @@ class TpuBlsVerifier:
         unique = [i for i, s in enumerate(sets) if freq[s.message] < 2]
         return shared, unique
 
-    def _plan_groups(self, sets):
-        """Choose a grouped-kernel config + row assignment, or None for the
-        flat path. Grouping pays when roots are shared (committee gossip);
-        a mostly-unique batch stays on the per-set kernel."""
-        uniq = len({s.message for s in sets})
-        if uniq * 2 > len(sets):
+    @staticmethod
+    def _plan_runs(keys, configs):
+        """Shared run-packing for both grouping axes: pack items into
+        per-key runs of ≤ lane_cap, ≤ rows_cap runs total; None when no
+        config fits or fewer than half the items share keys."""
+        uniq = len(set(keys))
+        if uniq * 2 > len(keys):
             return None
-        for rows_cap, lane_cap in self.kernels.grouped_configs:
-            if len(sets) > rows_cap * lane_cap:
+        for rows_cap, lane_cap in configs:
+            if len(keys) > rows_cap * lane_cap:
                 continue
             runs: list[list[int]] = []
             open_run: dict[bytes, list[int]] = {}
             fits = True
-            for idx, s in enumerate(sets):
-                run = open_run.get(s.message)
+            for idx, key in enumerate(keys):
+                run = open_run.get(key)
                 if run is not None and len(run) < lane_cap:
                     run.append(idx)
                 else:
                     run = [idx]
                     runs.append(run)
-                    open_run[s.message] = run
+                    open_run[key] = run
                     if len(runs) > rows_cap:
                         fits = False
                         break
             if fits:
                 return rows_cap, lane_cap, runs
         return None
+
+    def _plan_groups(self, sets):
+        """Choose a grouped-kernel config + row assignment, or None for the
+        flat path. Grouping pays when roots are shared (committee gossip);
+        a mostly-unique batch stays on the per-set kernel."""
+        return self._plan_runs(
+            [s.message for s in sets], self.kernels.grouped_configs
+        )
 
     def _marshal_grouped(self, sets, plan, raw: bool = False):
         """Scatter sets into (rows × lanes) by signing root; None if any
@@ -754,6 +899,89 @@ class TpuBlsVerifier:
             g.valid[row, :k] = True
         g.n = len(sets)
         return (g, sig_raw) if raw else g
+
+    def _plan_pk_groups(self, sets):
+        """Choose a pk-grouped config + row assignment, or None. Pays when
+        pubkeys repeat while roots do not (attacker-minted unique
+        AttestationData — the adversarial shape; VERDICT r4 #2)."""
+        try:
+            keys = [s.pubkey.to_bytes() for s in sets]
+        except (bls_api.BlsError, ValueError):
+            return None  # flat path reports the malformed set as False
+        return self._plan_runs(keys, self.kernels.pk_grouped_configs)
+
+    def _marshal_pk_grouped(self, sets, plan, raw: bool = False):
+        """Scatter sets into (rows × lanes) by pubkey; None if any set is
+        invalid. raw=True keeps signatures as bytes for the device.
+
+        This path's target workload is all-UNIQUE roots (the adversarial
+        flood), so the h2c cache never hits — messages are hashed through
+        the marshal pool in chunks (the C tier releases the GIL; hashing
+        scales with host cores like the reference's worker pool)."""
+        rows_cap, lane_cap, runs = plan
+        if raw:
+            pk_rows = self._pk_rows(sets)
+            if pk_rows is None:
+                return None
+            pk_x, pk_y = pk_rows
+            sig_all = np.frombuffer(
+                b"".join(s.signature for s in sets), np.uint8
+            ).reshape(len(sets), 96)
+            sig_raw = np.zeros((rows_cap, lane_cap, 96), np.uint8)
+        else:
+            limbs = self._native_limbs(sets)
+            if limbs is None:
+                return None
+            pk_x, pk_y, sig_x, sig_y = limbs
+        # pooled hash-to-curve over the (mostly-unique) roots
+        pool = _marshal_pool()
+        hits: list = [None] * len(sets)
+        if pool is not None and len(sets) >= 2 * _MARSHAL_CHUNK:
+            def hash_chunk(lo, hi):
+                return [self._hash_root(s.message) for s in sets[lo:hi]]
+
+            bounds = list(range(0, len(sets), _MARSHAL_CHUNK)) + [len(sets)]
+            futs = [
+                pool.submit(hash_chunk, lo, hi)
+                for lo, hi in zip(bounds[:-1], bounds[1:])
+            ]
+            out = []
+            for f in futs:
+                out.extend(f.result())
+            hits = out
+        else:
+            hits = [self._hash_root(s.message) for s in sets]
+        if any(h is None for h in hits):
+            return None
+        g = PkGroupedArrays(rows_cap, lane_cap)
+        for row, run in enumerate(runs):
+            g.pk_x[row], g.pk_y[row] = pk_x[run[0]], pk_y[run[0]]
+            for j, idx in enumerate(run):
+                g.msg_x[row, j], g.msg_y[row, j] = hits[idx]
+            idxs = np.asarray(run)
+            k = len(run)
+            if raw:
+                sig_raw[row, :k] = sig_all[idxs]
+            else:
+                g.sig_x[row, :k], g.sig_y[row, :k] = sig_x[idxs], sig_y[idxs]
+            g.valid[row, :k] = True
+        g.n = len(sets)
+        return (g, sig_raw) if raw else g
+
+    def _submit_pk_grouped(self, sets, plan):
+        """Dispatch one pk-grouped batch; None marks an invalid set."""
+        if self._device_decompress:
+            marshalled = self._marshal_pk_grouped(sets, plan, raw=True)
+            if marshalled is None:
+                return None
+            g, sig_raw = marshalled
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            return self.kernels.verify_pk_grouped_raw(g, sig_raw, a_bits, b_bits)
+        g = self._marshal_pk_grouped(sets, plan)
+        if g is None:
+            return None
+        a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        return self.kernels.verify_pk_grouped(g, a_bits, b_bits)
 
     def _marshal(self, sets, raw: bool = False):
         """Build padded device arrays; None if any set is invalid up front.
@@ -850,8 +1078,17 @@ class TpuBlsVerifier:
                 if result is None:
                     return lambda: False
                 return lambda: bool(result)
+            # roots don't group — try the DUAL axis: pubkeys repeat in
+            # any adversarial unique-root flood (bounded attacker keys)
+            pk_plan = self._plan_pk_groups(sets)
+            if pk_plan is not None:
+                result = self._submit_pk_grouped(sets, pk_plan)
+                if result is None:
+                    return lambda: False
+                return lambda: bool(result)
             # mixed batch: peel the shared-root sets onto the grouped
-            # kernel and leave only the singletons for the per-set kernel
+            # kernel; the singleton remainder tries pk-grouping before
+            # paying the per-set kernel
             shared, unique = self._split_shared_unique(sets)
             if shared and unique:
                 shared_sets = [sets[i] for i in shared]
@@ -860,7 +1097,14 @@ class TpuBlsVerifier:
                     grouped_res = self._submit_grouped(shared_sets, sub_plan)
                     if grouped_res is None:
                         return lambda: False
-                    flat = self._submit_flat([sets[i] for i in unique])
+                    unique_sets = [sets[i] for i in unique]
+                    pk_plan = self._plan_pk_groups(unique_sets)
+                    if pk_plan is not None:
+                        pk_res = self._submit_pk_grouped(unique_sets, pk_plan)
+                        if pk_res is None:
+                            return lambda: False
+                        return lambda: bool(grouped_res) and bool(pk_res)
+                    flat = self._submit_flat(unique_sets)
                     return lambda: bool(grouped_res) and flat()
         return self._submit_flat(sets)
 

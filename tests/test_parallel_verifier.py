@@ -337,3 +337,88 @@ def test_raw_path_grouped_shared_roots(raw_verifier):
         signature=wrong.sign(sets[7].message).to_bytes(),
     )
     assert raw_verifier.verify_signature_sets(sets) is False
+
+
+# --- pk-grouped (shared-pubkey, unique-root) path ---------------------------
+
+
+@pytest.fixture(scope="module")
+def pk_verifier():
+    return TpuBlsVerifier(
+        buckets=(4, 16), grouped_configs=((4, 4),),
+        pk_grouped_configs=((4, 4),), rng=_det_rng,
+    )
+
+
+def _make_unique_root_shared_pk_sets(n, n_keys, salt=0):
+    """n sets with UNIQUE messages over n_keys signer keys — the
+    adversarial unique-AttestationData flood shape."""
+    sets = []
+    for i in range(n):
+        sk = bls.interop_secret_key((i % n_keys) + salt)
+        msg = bytes([i, i ^ 0xFF]) * 16
+        sets.append(
+            bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+    return sets
+
+
+def test_pk_grouping_selected_for_unique_roots(pk_verifier):
+    sets = _make_unique_root_shared_pk_sets(12, 3)
+    assert pk_verifier._plan_groups(sets) is None  # roots never group
+    plan = pk_verifier._plan_pk_groups(sets)
+    assert plan is not None
+    rows_cap, lane_cap, runs = plan
+    assert sum(len(r) for r in runs) == 12
+    # every run holds ONE pubkey
+    for run in runs:
+        assert len({sets[i].pubkey.to_bytes() for i in run}) == 1
+    assert pk_verifier.verify_signature_sets(sets) is True
+
+
+def test_pk_grouped_detects_tampered_set(pk_verifier):
+    sets = _make_unique_root_shared_pk_sets(12, 3)
+    wrong = bls.interop_secret_key(55)
+    sets[7] = bls.SignatureSet(
+        pubkey=sets[7].pubkey,
+        message=sets[7].message,
+        signature=wrong.sign(sets[7].message).to_bytes(),
+    )
+    assert pk_verifier.verify_signature_sets(sets) is False
+
+
+def test_pk_grouped_raw_path():
+    v = TpuBlsVerifier(
+        buckets=(4,), grouped_configs=((4, 4),),
+        pk_grouped_configs=((4, 4),), rng=_det_rng,
+        device_decompress=True,
+    )
+    sets = _make_unique_root_shared_pk_sets(12, 3, salt=30)
+    assert v.verify_signature_sets(sets) is True
+    wrong = bls.interop_secret_key(66)
+    sets[2] = bls.SignatureSet(
+        pubkey=sets[2].pubkey,
+        message=sets[2].message,
+        signature=wrong.sign(sets[2].message).to_bytes(),
+    )
+    assert v.verify_signature_sets(sets) is False
+
+
+def test_pk_grouped_differential_vs_oracle(pk_verifier):
+    """Planner + kernel verdicts must agree with the oracle on the same
+    sets — both the valid and the tampered outcome."""
+    sets = _make_unique_root_shared_pk_sets(8, 2, salt=40)
+    assert bls.verify_signature_sets(sets) is True
+    assert pk_verifier.verify_signature_sets(sets) is True
+    wrong = bls.interop_secret_key(77)
+    sets[5] = bls.SignatureSet(
+        pubkey=sets[5].pubkey,
+        message=sets[5].message,
+        signature=wrong.sign(sets[5].message).to_bytes(),
+    )
+    assert bls.verify_signature_sets(sets) is False
+    assert pk_verifier.verify_signature_sets(sets) is False
