@@ -13,8 +13,14 @@ worst-case row (every root unique — range-sync-of-distinct-blocks shape)
 runs the per-set kernel and is reported alongside, as are the end-to-end
 wire→verdict rate and the incremental state-hashing numbers.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}; the full
-row set goes to bench_details.json and stderr.
+Harness (round-6 rewrite on `lodestar_tpu.observability.bench_emit`): every
+phase runs under its own deadline (LODESTAR_TPU_BENCH_PHASE_DEADLINE
+seconds, graceful skip on expiry) and the run ALWAYS ends in one JSON line
+on stdout — {"metric", "value", "unit", "vs_baseline", "phases",
+"stage_seconds", "planner", "partial"} — even when a phase dies or the
+driver's global timeout SIGTERMs the process mid-phase (the BENCH_r05
+`rc: 124, parsed: null` failure mode). The full document also goes to
+bench_details.json; progress lines go to stderr.
 """
 
 from __future__ import annotations
@@ -324,116 +330,117 @@ def _bench_hasher() -> dict:
     }
 
 
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main() -> None:
     import os
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+
+    from lodestar_tpu.observability import BenchEmitter
+    from lodestar_tpu.observability.stages import default_pipeline
+
+    # per-phase budget: SIGALRM raises inside the phase at the deadline,
+    # which is recorded as `status: timeout` and skipped — later phases
+    # still run, and the final JSON always prints (emitter atexit/SIGTERM)
+    deadline = float(os.environ.get("LODESTAR_TPU_BENCH_PHASE_DEADLINE", "600"))
+    # the watchdog THREAD emits + exits even when the main thread is stuck
+    # in a C call (XLA compile) that SIGALRM/SIGTERM cannot interrupt; set
+    # it below the driver's global timeout
+    global_deadline = float(
+        os.environ.get("LODESTAR_TPU_BENCH_GLOBAL_DEADLINE", "840")
+    )
+    pipeline = default_pipeline()
+    em = BenchEmitter(
+        "bls_signature_sets_verified_per_sec",
+        "sets/s",
+        baseline=BASELINE_SETS_PER_SEC,
+        details_path=os.path.join(here, "bench_details.json"),
+        global_deadline_s=global_deadline,
+    )
+    # emit-time sections: a mid-run kill still reports everything the
+    # pipeline observed up to the signal
+    em.add_section("stage_seconds", pipeline.stage_snapshot)
+    em.add_section("planner", pipeline.planner_snapshot)
+    em.extra["config"] = {
+        "grouped_batch": UNIQUE_ROOTS * GROUPED_LANES,
+        "unique_roots_per_batch": UNIQUE_ROOTS,
+        "worst_case_batch": WORST_CASE_BATCH,
+        "phase_deadline_s": deadline,
+    }
 
     import jax
 
     try:
         jax.devices()
     except RuntimeError:
-        # TPU tunnel unavailable — rerun on CPU so the bench always reports
+        # TPU tunnel unavailable — rerun on CPU so the bench always
+        # reports (execv replaces the image: no double emission)
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
 
     jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        "jax_compilation_cache_dir", os.path.join(here, ".jax_cache")
     )
 
-    print("bench: grouped phase...", file=sys.stderr, flush=True)
-    grouped_256 = _bench_grouped(jax)
-    print(f"bench: grouped {grouped_256:.1f} sets/s", file=sys.stderr, flush=True)
+    grouped_rate = None
+
+    def saw_rate(rate: float) -> None:
+        nonlocal grouped_rate
+        grouped_rate = max(grouped_rate or 0.0, rate)
+        em.set_headline(grouped_rate)
+
+    _log("bench: grouped phase...")
+    with em.phase("grouped_64x256", deadline_s=deadline) as ph:
+        rate = _bench_grouped(jax)
+        ph.record("device_sets_per_sec", round(rate, 2))
+        saw_rate(rate)
+        _log(f"bench: grouped {rate:.1f} sets/s")
     # wider lane buckets amortize the 2R+64-Miller fixed cost further;
     # the HEADLINE takes the best shape, but each shape's rate is
-    # recorded under its own key (no cross-shape mislabeling)
-    grouped_512 = grouped_1024 = None
-    util = None
-    grouped_rate = grouped_256
-    try:
-        grouped_512, util = _bench_grouped(jax, 512, utilization=True)
-        print(
-            f"bench: grouped 64x512 {grouped_512:.1f} sets/s "
-            f"(device busy fraction {util:.3f})",
-            file=sys.stderr, flush=True,
-        )
-        grouped_rate = max(grouped_rate, grouped_512)
-    except Exception as e:
-        print(f"grouped 64x512 failed: {e}", file=sys.stderr)
-    try:
-        grouped_1024 = _bench_grouped(jax, 1024)
-        print(
-            f"bench: grouped 64x1024 {grouped_1024:.1f} sets/s",
-            file=sys.stderr, flush=True,
-        )
-        grouped_rate = max(grouped_rate, grouped_1024)
-    except Exception as e:
-        print(f"grouped 64x1024 failed: {e}", file=sys.stderr)
-    print("bench: worst-case phase...", file=sys.stderr, flush=True)
-    try:
-        worst_rows = _bench_worst_case(jax)
-    except Exception as e:
-        print(f"worst-case bench failed: {e}", file=sys.stderr)
-        worst_rows = {}
-    print("bench: adversarial-mix phase...", file=sys.stderr, flush=True)
-    try:
+    # recorded under its own phase (no cross-shape mislabeling)
+    with em.phase("grouped_64x512", deadline_s=deadline) as ph:
+        rate, util = _bench_grouped(jax, 512, utilization=True)
+        ph.record("device_sets_per_sec", round(rate, 2))
+        ph.record("device_busy_fraction", round(util, 4))
+        pipeline.device_busy.set(round(util, 4))
+        saw_rate(rate)
+        _log(f"bench: grouped 64x512 {rate:.1f} sets/s (busy {util:.3f})")
+    with em.phase("grouped_64x1024", deadline_s=deadline) as ph:
+        rate = _bench_grouped(jax, 1024)
+        ph.record("device_sets_per_sec", round(rate, 2))
+        saw_rate(rate)
+        _log(f"bench: grouped 64x1024 {rate:.1f} sets/s")
+
+    _log("bench: worst-case phase...")
+    with em.phase("worst_case", deadline_s=deadline) as ph:
+        ph.update(_bench_worst_case(jax))
+
+    _log("bench: adversarial-mix phase...")
+    with em.phase("adversarial_mix_50pct", deadline_s=deadline) as ph:
         mix_rate = _bench_adversarial_mix(jax)
-    except Exception as e:
-        print(f"adversarial-mix bench failed: {e}", file=sys.stderr)
-        mix_rate = None
-    print("bench: e2e phase...", file=sys.stderr, flush=True)
-    try:
-        e2e_rows = _bench_e2e() or {}
-    except Exception as e:  # the headline metric must still report
-        print(f"e2e bench failed: {e}", file=sys.stderr)
-        e2e_rows = {}
-    try:
-        hasher_rows = _bench_hasher()
-    except Exception as e:
-        print(f"hasher bench failed: {e}", file=sys.stderr)
-        hasher_rows = {}
+        if mix_rate is not None:
+            ph.record("device_sets_per_sec", round(mix_rate, 2))
 
-    details = {
-        "device_sets_per_sec_grouped_64roots": round(grouped_256, 2),
-        "device_sets_per_sec_grouped_64x512": (
-            round(grouped_512, 2) if grouped_512 else None
-        ),
-        "device_sets_per_sec_grouped_64x1024": (
-            round(grouped_1024, 2) if grouped_1024 else None
-        ),
-        "device_busy_fraction_64x512": (
-            round(util, 4) if util is not None else None
-        ),
-        "device_sets_per_sec_headline": round(grouped_rate, 2),
-        **worst_rows,
-        "device_sets_per_sec_adversarial_mix_50pct": (
-            round(mix_rate, 2) if mix_rate else None
-        ),
-        "grouped_batch": UNIQUE_ROOTS * GROUPED_LANES,
-        "unique_roots_per_batch": UNIQUE_ROOTS,
-        "worst_case_batch": WORST_CASE_BATCH,
-        **e2e_rows,
-        **hasher_rows,
-    }
-    with open(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_details.json"),
-        "w",
-    ) as f:
-        json.dump(details, f, indent=2)
-    print(f"bench details: {details}", file=sys.stderr)
+    _log("bench: e2e phase...")
+    with em.phase("e2e", deadline_s=deadline) as ph:
+        ph.update(_bench_e2e() or {})
 
-    print(
-        json.dumps(
-            {
-                "metric": "bls_signature_sets_verified_per_sec",
-                "value": round(grouped_rate, 2),
-                "unit": "sets/s",
-                "vs_baseline": round(grouped_rate / BASELINE_SETS_PER_SEC, 4),
-            }
-        )
-    )
+    _log("bench: stage-profile phase...")
+    with em.phase("stage_profile", deadline_s=deadline) as ph:
+        from lodestar_tpu.observability.stage_profile import profile_stages
+
+        ph.update(profile_stages(pipeline, batch=256))
+
+    with em.phase("hasher", deadline_s=deadline) as ph:
+        ph.update(_bench_hasher())
+
+    doc = em.emit()
+    if doc is not None:
+        _log(f"bench details: {json.dumps(doc)[:2000]}")
 
 
 if __name__ == "__main__":

@@ -82,45 +82,51 @@ class CpuBlsVerifier:
 class DeviceBlsVerifier:
     """Device-tier verifier over the XLA batch kernels.
 
-    LODESTAR_TPU_PROFILE=<dir> wraps every dispatch in a
-    `jax.profiler.TraceAnnotation` and starts an XLA profiler trace into
-    <dir> on first use — the SURVEY §5 tracing hook at the verifier
-    boundary (view with TensorBoard/XProf)."""
+    Every dispatch runs inside a named `TraceAnnotation` scope (the
+    SURVEY §5 tracing hook at the verifier boundary; stages inside the
+    fused kernel carry `jax.named_scope` tags — view with
+    TensorBoard/XProf). Profiling starts three ways:
+    LODESTAR_TPU_PROFILE=<dir> auto-starts on first dispatch,
+    `start_profiling()` here, or the metrics server's `/profiler/start`
+    endpoint — all share one process-wide switch
+    (`observability.trace`)."""
 
     def __init__(
         self,
         buckets: tuple[int, ...] = (4, 16, 64, MAX_SIGNATURE_SETS_PER_JOB),
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
+        observer=None,
     ):
         import os
 
         from ..parallel.verifier import TpuBlsVerifier
 
         self._inner = TpuBlsVerifier(
-            buckets=buckets, grouped_configs=grouped_configs
+            buckets=buckets, grouped_configs=grouped_configs, observer=observer
         )
+        self.observer = self._inner.observer
         self.max_sets_per_job = buckets[-1]
         self._profile_dir = os.environ.get("LODESTAR_TPU_PROFILE")
-        self._profiling = False
 
     def _annotate(self, label: str):
-        import contextlib
+        from ..observability import trace
 
-        if not self._profile_dir:
-            return contextlib.nullcontext()
-        import jax
+        if self._profile_dir and not trace.profiling_active():
+            trace.start_profiling(self._profile_dir)
+        return trace.annotation(label)
 
-        if not self._profiling:
-            jax.profiler.start_trace(self._profile_dir)
-            self._profiling = True
-        return jax.profiler.TraceAnnotation(label)
+    def start_profiling(self, trace_dir: str | None = None):
+        from ..observability import trace
+
+        return trace.start_profiling(trace_dir or self._profile_dir)
 
     def stop_profiling(self) -> None:
-        if self._profiling:
-            import jax
+        from ..observability import trace
 
-            jax.profiler.stop_trace()
-            self._profiling = False
+        trace.stop_profiling()
+
+    def h2c_cache_size(self) -> int:
+        return len(self._inner._h2c_cache)
 
     def verify_signature_sets(self, sets) -> bool:
         sets = list(sets)
@@ -157,7 +163,9 @@ class BufferedVerifier:
     semantics, worker.ts:55-95 — realized as a second batched dispatch,
     not N round-trips)."""
 
-    def __init__(self, verifier: IBlsVerifier, prom=None):
+    def __init__(self, verifier: IBlsVerifier, prom=None, pipeline=None):
+        from ..observability.stages import default_pipeline
+
         self.verifier = verifier
         self._buffer: list[tuple[list[bls.SignatureSet], asyncio.Future, float]] = []
         self._flush_task: asyncio.Task | None = None
@@ -166,6 +174,16 @@ class BufferedVerifier:
         # feeds the bls-verifier dashboard rows (queue depth, buffer wait,
         # sets/job, fallback rate — reference blsThreadPool.*)
         self.prom = prom
+        # pipeline telemetry (flush reasons/latency, live queue gauge);
+        # inherits the node bundle's instance when wired with prom=
+        self.pipeline = (
+            pipeline
+            or getattr(prom, "pipeline", None)
+            or default_pipeline()
+        )
+        self.pipeline.bind_buffer_depth(
+            lambda: sum(len(s) for s, _, _ in self._buffer)
+        )
 
     async def verify(self, sets: Sequence[bls.SignatureSet], batchable: bool = False) -> bool:
         sets = list(sets)
@@ -180,16 +198,16 @@ class BufferedVerifier:
         if self.prom is not None:
             self.prom.bls_buffer_depth.set(buffered)
         if buffered >= MAX_BUFFERED_SIGS:
-            self._flush()
+            self._flush(reason="size")
         elif self._flush_task is None:
             self._flush_task = loop.create_task(self._delayed_flush())
         return await fut
 
     async def _delayed_flush(self) -> None:
         await asyncio.sleep(MAX_BUFFER_WAIT_MS / 1000)
-        self._flush()
+        self._flush(reason="timer")
 
-    def _flush(self) -> None:
+    def _flush(self, reason: str = "manual") -> None:
         if self._flush_task is not None:
             self._flush_task.cancel()
             self._flush_task = None
@@ -200,6 +218,7 @@ class BufferedVerifier:
         if self.prom is not None:
             for _, _, enq in buffer:
                 self.prom.bls_buffer_wait_seconds.observe(now - enq)
+        t0 = time.monotonic()
         try:
             per_request = _verify_merged(
                 self.verifier, [b[0] for b in buffer], self.metrics, self.prom
@@ -212,6 +231,7 @@ class BufferedVerifier:
                 "buffered batch verification failed (%s); resolving %d "
                 "requests as invalid", e, len(buffer),
             )
+        self.pipeline.flush(reason, latency_s=time.monotonic() - t0)
         for (_, fut, _), verdict in zip(buffer, per_request):
             if not fut.done():
                 fut.set_result(verdict)
@@ -264,8 +284,11 @@ class ThreadBufferedVerifier:
     flushes them at the deadline."""
 
     def __init__(self, verifier: IBlsVerifier, max_sigs: int = MAX_BUFFERED_SIGS,
-                 max_wait_ms: float = MAX_BUFFER_WAIT_MS, prom=None):
+                 max_wait_ms: float = MAX_BUFFER_WAIT_MS, prom=None,
+                 pipeline=None):
         import threading
+
+        from ..observability.stages import default_pipeline
 
         self.verifier = verifier
         self.max_sigs = max_sigs
@@ -275,6 +298,19 @@ class ThreadBufferedVerifier:
         self._entries: list[tuple[list, object, list]] = []
         self._timer: object | None = None
         self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
+        # pipeline telemetry: flush-reason counter, flush latency, and the
+        # LIVE buffer-depth gauge (collection-time callback — no polling)
+        self.pipeline = (
+            pipeline
+            or getattr(prom, "pipeline", None)
+            or getattr(verifier, "observer", None)
+            or default_pipeline()
+        )
+        self.pipeline.bind_buffer_depth(self._buffered_sigs)
+
+    def _buffered_sigs(self) -> int:
+        with self._lock:
+            return sum(len(e[0]) for e in self._entries)
 
     def __getattr__(self, name):
         # delegate everything else (stop_profiling, max_sets_per_job, …)
@@ -297,6 +333,8 @@ class ThreadBufferedVerifier:
         # batch size skip the wait window entirely — the async facade's
         # batchable=False contract (reference: verifySignatureSets opts)
         if not batchable or len(sets) >= self.max_sigs:
+            if self.prom is not None:
+                self.prom.bls_main_thread_sets_total.inc(len(sets))
             return self.verifier.verify_signature_sets(sets)
         ev = threading.Event()
         holder: list = [None]
@@ -313,7 +351,7 @@ class ThreadBufferedVerifier:
                 self._timer.daemon = True
                 self._timer.start()
         if flush_now is not None:
-            self._run_batch(flush_now)
+            self._run_batch(flush_now, reason="size")
         ev.wait()
         return holder[0]
 
@@ -329,13 +367,14 @@ class ThreadBufferedVerifier:
             self._timer = None
             entries = self._take_locked()
         if entries:
-            self._run_batch(entries)
+            self._run_batch(entries, reason="timer")
 
-    def _run_batch(self, entries) -> None:
+    def _run_batch(self, entries, reason: str = "manual") -> None:
         """Verify a merged batch and resolve every entry — ALWAYS: an
         exception here (device OOM, preemption) must resolve waiters as
         False rather than deadlock every blocked gossip/import thread
         (they hold no timeout on their Event)."""
+        t0 = time.monotonic()
         try:
             per_request = _verify_merged(
                 self.verifier, [e[0] for e in entries], self.metrics, self.prom
@@ -348,6 +387,7 @@ class ThreadBufferedVerifier:
                 "buffered batch verification failed; resolving %d requests "
                 "as invalid", len(entries),
             )
+        self.pipeline.flush(reason, latency_s=time.monotonic() - t0)
         for (_, ev, holder), verdict in zip(entries, per_request):
             holder[0] = verdict
             ev.set()

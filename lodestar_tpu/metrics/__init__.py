@@ -7,6 +7,13 @@ emitting the Prometheus text exposition format, the beacon/lodestar metric
 sets used by the services built so far, and the same HTTP surface.
 """
 
-from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    GaugeFunc,
+    Histogram,
+    MetricsRegistry,
+    Summary,
+)
 from .beacon import create_beacon_metrics  # noqa: F401
 from .server import MetricsServer  # noqa: F401

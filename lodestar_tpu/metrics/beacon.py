@@ -434,4 +434,13 @@ def create_beacon_metrics(registry: MetricsRegistry | None = None):
     m.h2c_cache_size = r.gauge(
         "lodestar_bls_verifier_h2c_cache_size", "hash-to-curve cache entries"
     )
+
+    # --- BLS pipeline telemetry (observability.stages) ------------------
+    # stage timers, planner-decision counters, flush/queue gauges, device
+    # busy fraction — registered on THIS registry so the families render
+    # on /metrics; verifier wiring takes the bundle via `m.pipeline`
+    # (node.py passes it to DeviceBlsVerifier/ThreadBufferedVerifier).
+    from ..observability.stages import create_pipeline_metrics
+
+    m.pipeline = create_pipeline_metrics(r)
     return m

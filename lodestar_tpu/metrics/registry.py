@@ -59,6 +59,78 @@ class Gauge(Counter):
             self._values[self._key(labels)] = float(value)
 
 
+class GaugeFunc(_Metric):
+    """Callback gauge: the value is pulled from a function at collection
+    time (prom-client's `collect()` hook) instead of being pushed with
+    `set()` — queue depths and cache sizes stay live without a polling
+    loop. Unlabeled by design; `set_function` allows late binding once
+    the observed object exists."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help_, fn=None):
+        super().__init__(name, help_, ())
+        self._fn = fn
+
+    def set_function(self, fn) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn())
+        except Exception:
+            return 0.0
+
+    def collect(self):
+        yield {}, self.value()
+
+
+class Summary(_Metric):
+    """Prometheus summary (sum + count, no quantile streams — the same
+    subset prom-client exports by default without `percentiles`)."""
+
+    kind = "summary"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._sums: dict[tuple, float] = {}
+        self._counts: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+    def count(self, **labels) -> int:
+        return self._counts.get(self._key(labels), 0)
+
+    def time(self, **labels):
+        """Context manager observing elapsed seconds."""
+        import time as _time
+
+        summ = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = _time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                summ.observe(_time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+
 class Histogram(_Metric):
     kind = "histogram"
     DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
@@ -118,6 +190,16 @@ class MetricsRegistry:
         self._metrics.append(m)
         return m
 
+    def summary(self, name, help_="", label_names=()):
+        m = Summary(self.prefix + name, help_, tuple(label_names))
+        self._metrics.append(m)
+        return m
+
+    def gauge_func(self, name, help_="", fn=None):
+        m = GaugeFunc(self.prefix + name, help_, fn)
+        self._metrics.append(m)
+        return m
+
     def expose(self) -> str:
         """Prometheus text exposition format."""
         lines: list[str] = []
@@ -139,6 +221,13 @@ class MetricsRegistry:
                     )
                     lines.append(f"{m.name}_sum{_fmt_labels(labels)} {m._sums[key]}")
                     lines.append(f"{m.name}_count{_fmt_labels(labels)} {total}")
+            elif isinstance(m, Summary):
+                for key, s in sorted(m._sums.items()):
+                    labels = dict(zip(m.label_names, key))
+                    lines.append(f"{m.name}_sum{_fmt_labels(labels)} {s}")
+                    lines.append(
+                        f"{m.name}_count{_fmt_labels(labels)} {m._counts[key]}"
+                    )
             else:
                 for labels, v in m.collect():
                     lines.append(f"{m.name}{_fmt_labels(labels)} {v}")

@@ -1,21 +1,78 @@
-"""/metrics HTTP endpoint (reference: `metrics/server/http.ts`)."""
+"""/metrics HTTP endpoint (reference: `metrics/server/http.ts`) plus the
+profiler control surface:
+
+    GET /metrics          Prometheus text exposition
+    POST /profiler/start  start an XLA profiler trace (?dir=<path>)
+    POST /profiler/stop   stop it; returns the trace directory
+
+(GET also accepted on the profiler routes — operator curl ergonomics.)
+The profiler hooks default to `observability.trace`, the same process-
+wide switch the device verifier uses, so the endpoint and
+LODESTAR_TPU_PROFILE cannot double-start a trace.
+"""
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class MetricsServer:
-    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        registry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        profiler_start=None,
+        profiler_stop=None,
+    ):
         reg = registry
+        if profiler_start is None or profiler_stop is None:
+            from ..observability import trace
+
+            profiler_start = profiler_start or trace.start_profiling
+            profiler_stop = profiler_stop or trace.stop_profiling
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
-            def do_GET(self):
-                if self.path.rstrip("/") not in ("", "/metrics"):
+            def _send_json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                route = parsed.path.rstrip("/")
+                if route == "/profiler/start":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    trace_dir = (q.get("dir") or [None])[0]
+                    started = profiler_start(trace_dir)
+                    if started is None:
+                        self._send_json(
+                            409,
+                            {"status": "error",
+                             "reason": "trace already running or profiler unavailable"},
+                        )
+                    else:
+                        self._send_json(200, {"status": "started", "dir": started})
+                    return
+                if route == "/profiler/stop":
+                    stopped = profiler_stop()
+                    if stopped is None:
+                        self._send_json(
+                            409, {"status": "error", "reason": "no trace running"}
+                        )
+                    else:
+                        self._send_json(200, {"status": "stopped", "dir": stopped})
+                    return
+                if route not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
                     return
@@ -27,6 +84,12 @@ class MetricsServer:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle()
+
+            def do_POST(self):
+                self._handle()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
 

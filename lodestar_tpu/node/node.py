@@ -85,7 +85,13 @@ class BeaconNode:
                 ThreadBufferedVerifier,
             )
 
-            verifier = ThreadBufferedVerifier(DeviceBlsVerifier())
+            # pipeline telemetry rides the node registry: stage timers +
+            # planner counters from the device tier, flush/queue gauges
+            # from the batching facade — all on /metrics by default
+            verifier = ThreadBufferedVerifier(
+                DeviceBlsVerifier(observer=self.metrics.pipeline),
+                prom=self.metrics,
+            )
         else:
             verifier = CpuBlsVerifier()
         self.chain = BeaconChain(
@@ -195,11 +201,11 @@ class BeaconNode:
                 m.seen_cache_size.set(len(cache._seen), kind=kind)
             except (AttributeError, TypeError):
                 pass
-        verifier = getattr(self.chain, "bls_verifier", None)
-        inner = getattr(verifier, "inner", verifier)
-        cache = getattr(inner, "_h2c_cache", None)
-        if cache is not None:
-            m.h2c_cache_size.set(len(cache))
+        # h2c cache size via the DeviceBlsVerifier seam (ThreadBuffered
+        # facade delegates); CpuBlsVerifier has no cache — gauge stays 0
+        sizer = getattr(self.chain.bls, "h2c_cache_size", None)
+        if callable(sizer):
+            m.h2c_cache_size.set(sizer())
         # 0 stalled / 1 syncing / 2 synced: synced = within one slot of
         # the clock; stalled = behind AND head unchanged for >3 slots
         head = self.chain.head_state.state.slot

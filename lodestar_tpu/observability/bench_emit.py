@@ -1,0 +1,250 @@
+"""Structured bench emitter: a benchmark run ALWAYS ends in one JSON doc.
+
+BENCH_r05 recorded `rc: 124, parsed: null`: the harness hit the driver's
+global timeout mid-phase and emitted nothing. This module kills that
+failure mode three ways:
+
+- **per-phase deadlines** (`phase(name, deadline_s=...)`): SIGALRM raises
+  `PhaseTimeout` inside the phase, which is recorded as `status: timeout`
+  and skipped gracefully — later phases still run. (A deadline can only
+  interrupt Python bytecode; a single long C/XLA call returns first. The
+  layers below keep per-call work bounded so this is the common case.)
+- **SIGTERM flush**: the driver's `timeout` sends SIGTERM; the handler
+  emits the document with whatever phases completed before exiting.
+- **atexit flush**: any other exit path (exception, sys.exit) emits too.
+- **watchdog thread** (`global_deadline_s`): signal handlers only run on
+  the main thread between bytecodes — a main thread stuck inside a long
+  XLA compile (a C call) would ride SIGTERM straight into `timeout -k`'s
+  SIGKILL with nothing printed. The watchdog is an ordinary daemon
+  thread, immune to that: at the budget it emits the partial document
+  and `os._exit(124)`s before the external killer fires.
+
+The document's final stdout line is a single JSON object carrying the
+headline metric plus per-phase throughput, the stage-time breakdown, and
+planner-decision counts (sections are registered as callables and read at
+emit time, so a mid-run kill still reports everything observed so far).
+
+Deliberately import-light (stdlib only): the emitter must work even when
+jax fails to initialize — that failure is itself a reportable result.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+
+class PhaseTimeout(Exception):
+    """Raised inside a phase body when its deadline expires."""
+
+
+class _Phase:
+    __slots__ = ("rec",)
+
+    def __init__(self, rec: dict):
+        self.rec = rec
+
+    def record(self, key: str, value) -> None:
+        self.rec["rows"][key] = value
+
+    def update(self, rows: dict) -> None:
+        self.rec["rows"].update(rows)
+
+
+class _PhaseContext:
+    def __init__(self, emitter: "BenchEmitter", name: str, deadline_s):
+        self._em = emitter
+        self._name = name
+        self._deadline = deadline_s
+        self._prev_handler = None
+        self._armed = False
+
+    def __enter__(self) -> _Phase:
+        rec = {"status": "running", "seconds": None, "rows": {}}
+        if self._deadline is not None:
+            rec["deadline_s"] = self._deadline
+        self._em.phases[self._name] = rec
+        self._rec = rec
+        self._t0 = time.monotonic()
+        if self._deadline is not None and self._deadline > 0:
+            try:  # SIGALRM only works on the main thread
+                def _expire(signum, frame):
+                    raise PhaseTimeout(self._name)
+
+                self._prev_handler = signal.signal(signal.SIGALRM, _expire)
+                signal.setitimer(signal.ITIMER_REAL, self._deadline)
+                self._armed = True
+            except (ValueError, AttributeError, OSError):
+                pass
+        return _Phase(rec)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._armed:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+            signal.signal(signal.SIGALRM, self._prev_handler)
+        self._rec["seconds"] = round(time.monotonic() - self._t0, 3)
+        if exc_type is None:
+            self._rec["status"] = "ok"
+            return False
+        if issubclass(exc_type, PhaseTimeout):
+            self._rec["status"] = "timeout"
+            return True  # graceful skip: later phases still run
+        if issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+            self._rec["status"] = "interrupted"
+            return False  # propagate; atexit/SIGTERM emit the partial doc
+        self._rec["status"] = "error"
+        self._rec["error"] = f"{exc_type.__name__}: {exc}"
+        return True  # graceful skip
+
+
+class BenchEmitter:
+    """Collects phases/sections and guarantees exactly one JSON emission.
+
+    Usage:
+        em = BenchEmitter("sets_per_sec", "sets/s", baseline=50_000.0)
+        em.add_section("planner", lambda: pipeline.planner_snapshot())
+        with em.phase("grouped", deadline_s=120) as ph:
+            ph.record("sets_per_sec", rate)
+        em.set_headline(rate)
+        em.emit()
+    """
+
+    def __init__(
+        self,
+        metric: str,
+        unit: str,
+        baseline: float | None = None,
+        details_path: str | None = None,
+        stream=None,
+        global_deadline_s: float | None = None,
+    ):
+        self.metric = metric
+        self.unit = unit
+        self.baseline = baseline
+        self.details_path = details_path
+        self.stream = stream if stream is not None else sys.stdout
+        self.phases: dict[str, dict] = {}
+        self.extra: dict = {}
+        self._sections: dict[str, object] = {}
+        self._headline: float | None = None
+        self._emitted = False
+        self._lock = threading.Lock()
+        atexit.register(self._emit_atexit)
+        self._install_sigterm()
+        if global_deadline_s is not None and global_deadline_s > 0:
+            t = threading.Thread(
+                target=self._watchdog, args=(global_deadline_s,),
+                name="bench-watchdog", daemon=True,
+            )
+            t.start()
+
+    # -- recording ----------------------------------------------------------
+
+    def phase(self, name: str, deadline_s: float | None = None) -> _PhaseContext:
+        return _PhaseContext(self, name, deadline_s)
+
+    def add_section(self, name: str, provider) -> None:
+        """Register a section rendered at EMIT time — `provider` is a dict
+        or a zero-arg callable returning one (callables see everything
+        observed up to the kill, not just up to registration)."""
+        self._sections[name] = provider
+
+    def set_headline(self, value: float) -> None:
+        self._headline = value
+
+    # -- emission -----------------------------------------------------------
+
+    def document(self) -> dict:
+        phases_done = [p for p in self.phases.values() if p["status"] == "ok"]
+        partial = len(phases_done) != len(self.phases) or not self.phases
+        value = self._headline
+        if value is None:
+            # best observed per-phase throughput, else 0.0 — the document
+            # must always carry a numeric headline (never `parsed: null`)
+            rates = [
+                v
+                for p in self.phases.values()
+                for k, v in p["rows"].items()
+                if k.endswith("sets_per_sec") and isinstance(v, (int, float)) and v
+            ]
+            value = max(rates) if rates else 0.0
+            partial = True
+        doc = {
+            "metric": self.metric,
+            "value": round(float(value), 2),
+            "unit": self.unit,
+            "partial": partial,
+            "phases": self.phases,
+        }
+        if self.baseline:
+            doc["vs_baseline"] = round(float(value) / self.baseline, 4)
+        for name, provider in self._sections.items():
+            try:
+                doc[name] = provider() if callable(provider) else provider
+            except Exception as e:  # a broken section must not block emission
+                doc[name] = {"error": str(e)}
+        doc.update(self.extra)
+        return doc
+
+    def emit(self) -> dict | None:
+        """Write the details file and print the one-line JSON document.
+        Idempotent: only the first call (from any path — normal return,
+        atexit, SIGTERM) emits."""
+        with self._lock:
+            if self._emitted:
+                return None
+            self._emitted = True
+        doc = self.document()
+        if self.details_path:
+            try:
+                with open(self.details_path, "w") as f:
+                    json.dump(doc, f, indent=2)
+            except OSError as e:
+                print(f"bench: details write failed: {e}", file=sys.stderr)
+        print(json.dumps(doc), file=self.stream, flush=True)
+        return doc
+
+    def _emit_atexit(self) -> None:
+        self.emit()
+
+    def _watchdog(self, budget_s: float) -> None:
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._emitted:
+                    return
+            time.sleep(min(1.0, max(0.01, deadline - time.monotonic())))
+        with self._lock:
+            done = self._emitted
+        if done:
+            return
+        for rec in self.phases.values():
+            if rec["status"] == "running":
+                rec["status"] = "killed"
+        self.extra["watchdog_fired_after_s"] = budget_s
+        self.emit()
+        os._exit(124)
+
+    def _install_sigterm(self) -> None:
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                # mark the in-flight phase so the doc shows where the kill hit
+                for rec in self.phases.values():
+                    if rec["status"] == "running":
+                        rec["status"] = "killed"
+                self.emit()
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    os._exit(143)
+
+            signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass  # not the main thread / unsupported platform

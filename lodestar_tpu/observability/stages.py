@@ -1,0 +1,244 @@
+"""Stage timers + planner counters + queue gauges for the BLS pipeline.
+
+The stage taxonomy follows the verification dataflow (docs/observability.md):
+
+host stages (timed inline, monotonic clock):
+    marshal        wire bytes -> limb arrays (C tier decompress/subgroup)
+    hash_to_curve  H(m) for cache-missed signing roots (C tier)
+    rand           random-coefficient bit planes
+    dispatch       host->XLA submit time (async; excludes device compute)
+    device_wait    resolver block time (`block_until_ready`-bounded)
+
+device stages (attributable two ways: `trace.named_scope` tags inside the
+fused kernel for XLA profiles, and `stage_profile.profile_stages` timing
+per-stage sub-kernels into the SAME histogram for the bench breakdown):
+    g2_decompress, scalar_mul, msm_planes, miller_loop, product_tree,
+    final_exp
+
+All families live in a `metrics.registry.MetricsRegistry` so they render
+on `/metrics` next to the rest of the node's families. `default_pipeline()`
+backs unwired verifiers (bench, tools) with a process-local registry;
+`create_beacon_metrics` attaches a node-wired instance as `m.pipeline`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..metrics.registry import MetricsRegistry
+
+STAGES = (
+    "marshal",
+    "hash_to_curve",
+    "rand",
+    "dispatch",
+    "device_wait",
+    "g2_decompress",
+    "scalar_mul",
+    "msm_planes",
+    "miller_loop",
+    "product_tree",
+    "final_exp",
+)
+
+# planner decisions (parallel/verifier.verify_signature_sets_submit):
+#   root_grouped  whole batch on the root-grouped kernel
+#   pk_grouped    whole batch on the pubkey-grouped (dual) kernel
+#   split         shared-root part peeled off, remainder routed separately
+#                 (the parts also count under their own paths)
+#   per_set       flat per-set kernel (nothing grouped)
+#   individual    per-set verdict retry path
+PLANNER_PATHS = ("root_grouped", "pk_grouped", "split", "per_set", "individual")
+
+_STAGE_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60,
+)
+_GROUP_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _block_until_ready(x):
+    try:
+        import jax
+
+        jax.block_until_ready(x)
+    except Exception:
+        pass
+
+
+class _StageTimer:
+    """Context manager: observes monotonic elapsed seconds into the stage
+    histogram. `bound(x)` registers a value to `block_until_ready` before
+    the clock stops, so async dispatch results are timed to completion."""
+
+    __slots__ = ("_pipeline", "_stage", "_bound", "t0", "elapsed")
+
+    def __init__(self, pipeline: "PipelineMetrics", stage: str):
+        self._pipeline = pipeline
+        self._stage = stage
+        self._bound = None
+        self.elapsed = 0.0
+
+    def bound(self, x):
+        self._bound = x
+        return x
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if self._bound is not None:
+            _block_until_ready(self._bound)
+        self.elapsed = time.monotonic() - self.t0
+        self._pipeline.observe_stage(self._stage, self.elapsed)
+        return False
+
+
+class PipelineMetrics:
+    """The telemetry families + recording API for one verifier pipeline."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        r = registry if registry is not None else MetricsRegistry()
+        self.registry = r
+        self.stage_seconds = r.histogram(
+            "lodestar_bls_pipeline_stage_seconds",
+            "per-stage latency of the BLS verification pipeline",
+            label_names=("stage",),
+            buckets=_STAGE_BUCKETS,
+        )
+        self.planner_decisions = r.counter(
+            "lodestar_bls_verifier_planner_decisions_total",
+            "batch-planner routing decisions by kernel path",
+            label_names=("path",),
+        )
+        self.planner_sets = r.counter(
+            "lodestar_bls_verifier_planner_sets_total",
+            "signature sets routed per kernel path",
+            label_names=("path",),
+        )
+        self.planner_group_size = r.histogram(
+            "lodestar_bls_verifier_planner_group_size",
+            "sets per group row chosen by the planner",
+            buckets=_GROUP_SIZE_BUCKETS,
+        )
+        self.cache_events = r.counter(
+            "lodestar_bls_verifier_cache_events_total",
+            "dedup cache hits/misses (h2c roots, pubkey limbs)",
+            label_names=("cache", "outcome"),
+        )
+        self.flushes = r.counter(
+            "lodestar_bls_verifier_flushes_total",
+            "buffer flushes by trigger reason (size/timer/manual)",
+            label_names=("reason",),
+        )
+        self.flush_seconds = r.histogram(
+            "lodestar_bls_verifier_flush_seconds",
+            "flush latency: merged batch verify incl. fallback",
+            buckets=_STAGE_BUCKETS,
+        )
+        self.buffer_depth = r.gauge_func(
+            "lodestar_bls_verifier_buffer_depth",
+            "signature sets currently buffered (live callback, no polling)",
+        )
+        self.device_busy = r.gauge(
+            "lodestar_bls_verifier_device_busy_fraction",
+            "fraction of wall time the device spent on verify dispatches",
+        )
+        # device-busy sampler state: busy seconds accumulate per resolve,
+        # the fraction is re-sampled over >=1 s wall windows
+        self._busy_lock = threading.Lock()
+        self._busy_accum = 0.0
+        self._busy_window_t0 = time.monotonic()
+
+    # -- stage timers -------------------------------------------------------
+
+    def stage(self, name: str) -> _StageTimer:
+        return _StageTimer(self, name)
+
+    def observe_stage(self, name: str, seconds: float) -> None:
+        self.stage_seconds.observe(seconds, stage=name)
+
+    # -- planner ------------------------------------------------------------
+
+    def planner(self, path: str, n_sets: int, group_sizes=None) -> None:
+        self.planner_decisions.inc(path=path)
+        self.planner_sets.inc(n_sets, path=path)
+        if group_sizes:
+            for size in group_sizes:
+                self.planner_group_size.observe(size)
+
+    def cache_event(self, cache: str, hit: bool, n: int = 1) -> None:
+        if n:
+            self.cache_events.inc(n, cache=cache, outcome="hit" if hit else "miss")
+
+    # -- queue / flush ------------------------------------------------------
+
+    def bind_buffer_depth(self, fn) -> None:
+        self.buffer_depth.set_function(fn)
+
+    def flush(self, reason: str, latency_s: float | None = None) -> None:
+        self.flushes.inc(reason=reason)
+        if latency_s is not None:
+            self.flush_seconds.observe(latency_s)
+
+    def device_busy_sample(self, busy_s: float) -> None:
+        """Accumulate one dispatch's device-busy seconds; refresh the
+        busy-fraction gauge once per >=1 s wall window (short windows are
+        all noise at ms dispatch times)."""
+        now = time.monotonic()
+        with self._busy_lock:
+            self._busy_accum += busy_s
+            elapsed = now - self._busy_window_t0
+            if elapsed >= 1.0:
+                self.device_busy.set(min(1.0, self._busy_accum / elapsed))
+                self._busy_accum = 0.0
+                self._busy_window_t0 = now
+
+    # -- snapshots (bench emitter) -----------------------------------------
+
+    def stage_snapshot(self) -> dict:
+        """{stage: {"sum_s", "count"}} for every stage observed so far."""
+        out = {}
+        for labels, _ in self.stage_seconds._counts.items():
+            stage = labels[0]
+            out[stage] = {
+                "sum_s": round(self.stage_seconds._sums[labels], 6),
+                "count": self.stage_seconds._totals[labels],
+            }
+        return out
+
+    def planner_snapshot(self) -> dict:
+        decisions = {
+            labels.get("path", ""): int(v)
+            for labels, v in self.planner_decisions.collect()
+        }
+        sets = {
+            labels.get("path", ""): int(v)
+            for labels, v in self.planner_sets.collect()
+        }
+        caches = {
+            f'{labels["cache"]}_{labels["outcome"]}': int(v)
+            for labels, v in self.cache_events.collect()
+        }
+        return {"decisions": decisions, "sets": sets, "cache_events": caches}
+
+
+def create_pipeline_metrics(registry: MetricsRegistry) -> PipelineMetrics:
+    """Register the pipeline families on an existing node registry."""
+    return PipelineMetrics(registry)
+
+
+_default: PipelineMetrics | None = None
+_default_lock = threading.Lock()
+
+
+def default_pipeline() -> PipelineMetrics:
+    """Process-local fallback instance for unwired verifiers (bench,
+    tools, ad-hoc scripts). Node code should wire `m.pipeline` from
+    `create_beacon_metrics` instead so the families reach `/metrics`."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = PipelineMetrics()
+        return _default

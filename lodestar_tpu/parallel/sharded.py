@@ -27,6 +27,22 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import fp, fp2, fp12, msm
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions: the top-level API (with
+    `check_vma`) landed after 0.4.x, where it lives in
+    `jax.experimental.shard_map` and the kwarg is `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 from ..ops.pairing import (
     final_exponentiation,
     miller_loop_proj_pq,
@@ -118,12 +134,11 @@ def make_sharded_verifier(mesh: Mesh, axis: str = "dp"):
 
     @jax.jit
     def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
-        fn = jax.shard_map(
+        fn = _shard_map(
             partial(_sharded_verify, axis),
             mesh=mesh,
             in_specs=(spec,) * 8,
             out_specs=P(),
-            check_vma=False,
         )
         return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid)
 
@@ -148,7 +163,12 @@ def _grouped_local(
     64/n per chip so the pairing work shards too."""
     r_loc, lanes = pk_x.shape[0], pk_x.shape[1]
     n_loc = r_loc * lanes
-    ndev = lax.axis_size(mesh_axis)
+    # lax.axis_size is newer-jax; psum(1, axis) is the 0.4.x idiom (static)
+    ndev = (
+        lax.axis_size(mesh_axis)
+        if hasattr(lax, "axis_size")
+        else lax.psum(1, mesh_axis)
+    )
 
     pk = (pk_x, pk_y, fp.one((r_loc, lanes)))
     pk = g1.select(valid, pk, g1.infinity((r_loc, lanes)))
@@ -231,12 +251,11 @@ def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
 
     @jax.jit
     def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid):
-        fn = jax.shard_map(
+        fn = _shard_map(
             partial(_sharded_grouped_verify, axis),
             mesh=mesh,
             in_specs=(spec,) * 9,
             out_specs=P(),
-            check_vma=False,
         )
         return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid)
 

@@ -31,6 +31,7 @@ next tier.
 from __future__ import annotations
 
 import secrets
+import time
 from functools import partial
 
 import jax
@@ -39,6 +40,8 @@ import numpy as np
 
 from ..bls import api as bls_api
 from ..bls.hash_to_curve import hash_to_g2
+from ..observability.stages import default_pipeline
+from ..observability.trace import named_scope
 from ..ops import fp, fp2, fp12, msm
 from ..ops.g2_decompress import decompress as _g2_decompress, planes_in_subgroup as _planes_in_subgroup
 from ..ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
@@ -112,7 +115,8 @@ def batch_verify_kernel_raw(pk_x, pk_y, msg_x, msg_y, sig_raw, r_bits, valid):
     flags, off-curve, infinity) makes the verdict False — matching the
     host-marshal path, where `_native_limbs` returns None and the caller
     reports False."""
-    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    with named_scope("bls/g2_decompress"):
+        sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
     decode_fail = jnp.any(valid & ~dec_ok)
     verdict = _batch_verify_impl(
         pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits,
@@ -149,15 +153,17 @@ def _batch_verify_impl(
     """
     n = pk_x.shape[0]
     # r_i·pk_i (G1, projective out of the scan — no inversion)
-    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+    with named_scope("bls/scalar_mul"):
+        rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
 
     # signature side: global bit-plane sums over all N lanes (LSB-first
     # planes; r_bits arrive MSB-first)
     sig = (sig_x, sig_y, fp2.one((n,)))
     sig = g2.select(valid, sig, g2.infinity((n,)))
-    u_planes = msm.masked_plane_sums(
-        g2, sig, jnp.flip(r_bits, axis=-1)
-    )  # (64, …) projective
+    with named_scope("bls/msm_planes"):
+        u_planes = msm.masked_plane_sums(
+            g2, sig, jnp.flip(r_bits, axis=-1)
+        )  # (64, …) projective
 
     # Pair lanes: N (r_i·pk_i, H(m_i)) plus 64 (−[2^b]g1, U_b)
     px = jnp.concatenate([rpk[0], NEG_G1_POW2_64_X], 0)
@@ -170,9 +176,13 @@ def _batch_verify_impl(
         [valid, ~g2.is_infinity(u_planes)], 0
     )
 
-    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    with named_scope("bls/miller_loop"):
+        fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((n + R_BITS,)))
-    verdict = fp12.is_one(final_exponentiation(_fp12_product_tree(fs)))
+    with named_scope("bls/product_tree"):
+        prod = _fp12_product_tree(fs)
+    with named_scope("bls/final_exp"):
+        verdict = fp12.is_one(final_exponentiation(prod))
     if check_planes:
         # signature subgroup membership, batched: ψ(U_b) == [x]U_b on the
         # 64 random bit-planes (2^-63 even with the forced-nonzero bit —
@@ -196,7 +206,8 @@ def grouped_verify_kernel_raw(
     """`grouped_verify_kernel` taking RAW 96-byte compressed signatures
     (R, L, 96) — device decompression + plane subgroup checks, same
     contract as `batch_verify_kernel_raw`."""
-    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    with named_scope("bls/g2_decompress"):
+        sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
     decode_fail = jnp.any(valid & ~dec_ok)
     verdict = _grouped_verify_impl(
         pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits,
@@ -250,11 +261,12 @@ def _grouped_verify_impl(
     bits = jnp.concatenate([a_bits, b_bits], axis=-1)  # (R, L, 64)
 
     # per-root bit-plane sums: (64, R) G1 projective
-    t_planes = msm.masked_plane_sums(g1, pk, bits)
-    # A_j (a-half) and B_j (b-half) via one Horner over (2, R) lanes
-    tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in t_planes)
-    tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)  # (32, 2, R, …)
-    ab = msm.horner_pow2(g1, tp)  # (2, R) projective
+    with named_scope("bls/msm_planes"):
+        t_planes = msm.masked_plane_sums(g1, pk, bits)
+        # A_j (a-half) and B_j (b-half) via one Horner over (2, R) lanes
+        tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in t_planes)
+        tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)  # (32, 2, R, …)
+        ab = msm.horner_pow2(g1, tp)  # (2, R) projective
     a_pt = tuple(c[0] for c in ab)
     b_pt = tuple(c[1] for c in ab)
 
@@ -265,7 +277,10 @@ def _grouped_verify_impl(
         fp2.one((n,)),
     )
     sig = g2.select(valid.reshape(n), sig, g2.infinity((n,)))
-    u_planes = msm.masked_plane_sums(g2, sig, bits.reshape(n, 2 * HALF_BITS))
+    with named_scope("bls/msm_planes"):
+        u_planes = msm.masked_plane_sums(
+            g2, sig, bits.reshape(n, 2 * HALF_BITS)
+        )
     u_a = tuple(c[:HALF_BITS] for c in u_planes)
     u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
 
@@ -287,9 +302,13 @@ def _grouped_verify_impl(
 
     # e(O, ·) = e(·, O) = 1: mask infinity lanes (empty rows, zero planes)
     lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
-    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    with named_scope("bls/miller_loop"):
+        fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((2 * R + 2 * HALF_BITS,)))
-    verdict = fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    with named_scope("bls/product_tree"):
+        prod = fp12.product_tree(fs)
+    with named_scope("bls/final_exp"):
+        verdict = fp12.is_one(final_exponentiation(prod))
     if check_planes:
         # u_planes BEFORE the ψ split: 64 iid random-bit planes of the
         # signature lanes (soundness analysis in ops/g2_decompress.py)
@@ -311,7 +330,8 @@ def pk_grouped_verify_kernel_raw(
 ):
     """`pk_grouped_verify_kernel` taking RAW 96-byte compressed signatures
     (R, L, 96) — device decompression + plane subgroup checks."""
-    sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    with named_scope("bls/g2_decompress"):
+        sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
     decode_fail = jnp.any(valid & ~dec_ok)
     verdict = _pk_grouped_verify_impl(
         pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits,
@@ -360,10 +380,11 @@ def _pk_grouped_verify_impl(
     bits = jnp.concatenate([a_bits, b_bits], axis=-1)  # (R, L, 64)
 
     # per-row message bit-plane sums: (64, R) G2 projective
-    m_planes = msm.masked_plane_sums(g2, msgs, bits)
-    tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in m_planes)
-    tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)  # (32, 2, R, …)
-    ab = msm.horner_pow2(g2, tp)  # (2, R) projective
+    with named_scope("bls/msm_planes"):
+        m_planes = msm.masked_plane_sums(g2, msgs, bits)
+        tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in m_planes)
+        tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)  # (32, 2, R, …)
+        ab = msm.horner_pow2(g2, tp)  # (2, R) projective
     a_pt = tuple(c[0] for c in ab)
     b_pt = tuple(c[1] for c in ab)
     q_row = g2.add(a_pt, g2_psi(b_pt))  # Σ r_i·H_i per row
@@ -375,7 +396,10 @@ def _pk_grouped_verify_impl(
         fp2.one((n,)),
     )
     sig = g2.select(valid.reshape(n), sig, g2.infinity((n,)))
-    u_planes = msm.masked_plane_sums(g2, sig, bits.reshape(n, 2 * HALF_BITS))
+    with named_scope("bls/msm_planes"):
+        u_planes = msm.masked_plane_sums(
+            g2, sig, bits.reshape(n, 2 * HALF_BITS)
+        )
     u_a = tuple(c[:HALF_BITS] for c in u_planes)
     u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
 
@@ -387,9 +411,13 @@ def _pk_grouped_verify_impl(
     qz = jnp.concatenate([q_row[2], u_a[2], u_b[2]], 0)
 
     lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
-    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    with named_scope("bls/miller_loop"):
+        fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((R + 2 * HALF_BITS,)))
-    verdict = fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    with named_scope("bls/product_tree"):
+        prod = fp12.product_tree(fs)
+    with named_scope("bls/final_exp"):
+        verdict = fp12.is_one(final_exponentiation(prod))
     if check_planes:
         verdict = verdict & _planes_in_subgroup(u_planes)
     return verdict
@@ -409,9 +437,11 @@ def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
     ys = jnp.concatenate([pk_y, jnp.broadcast_to(neg_gy, (n, N_LIMBS))], 0)
     qx = jnp.concatenate([msg_x, sig_x], 0)
     qy = jnp.concatenate([msg_y, sig_y], 0)
-    fs = miller_loop((xs, ys), (qx, qy))
+    with named_scope("bls/miller_loop"):
+        fs = miller_loop((xs, ys), (qx, qy))
     prod = fp12.mul(fs[:n], fs[n:])
-    return fp12.is_one(final_exponentiation(prod)) & valid
+    with named_scope("bls/final_exp"):
+        return fp12.is_one(final_exponentiation(prod)) & valid
 
 
 class SetArrays:
@@ -642,8 +672,13 @@ class TpuBlsVerifier:
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
         device_decompress: bool | None = None,
         pk_grouped_configs: tuple[tuple[int, int], ...] = ((128, 32),),
+        observer=None,
     ):
         self.kernels = BatchVerifier(buckets, grouped_configs, pk_grouped_configs)
+        # pipeline telemetry (observability.stages.PipelineMetrics): stage
+        # timers, planner counters, cache hit rates. Node wiring passes the
+        # /metrics-registered instance; the default keeps bench/tools lit.
+        self.observer = observer if observer is not None else default_pipeline()
         self._custom_rng = rng
         self._rng = rng if rng is not None else (lambda: secrets.randbits(R_BITS))
         # hash-to-curve cache keyed by signing root: committee gossip
@@ -704,9 +739,11 @@ class TpuBlsVerifier:
         cache = self._h2c_cache
         with self._h2c_lock:
             hit = cache.get(key)
+        self.observer.cache_event("h2c", hit is not None)
         if hit is None:
             # hash OUTSIDE the lock (ms-scale C work, GIL released)
-            rc, limbs = _native.bls_hash_to_g2(key, bls_api.DST_G2)
+            with self.observer.stage("hash_to_curve"):
+                rc, limbs = _native.bls_hash_to_g2(key, bls_api.DST_G2)
             if rc != 0:
                 return None
             hit = (limbs[0], limbs[1])
@@ -732,6 +769,8 @@ class TpuBlsVerifier:
         with self._pk_lock:
             rows = [self._pk_cache.get(k) for k in keys]
         misses = {k for k, r in zip(keys, rows) if r is None}
+        self.observer.cache_event("pk", True, n=len(keys) - len(misses))
+        self.observer.cache_event("pk", False, n=len(misses))
         if misses:
             fresh = {}
             for k in misses:
@@ -970,18 +1009,29 @@ class TpuBlsVerifier:
 
     def _submit_pk_grouped(self, sets, plan):
         """Dispatch one pk-grouped batch; None marks an invalid set."""
+        self.observer.planner(
+            "pk_grouped", len(sets), group_sizes=[len(r) for r in plan[2]]
+        )
         if self._device_decompress:
-            marshalled = self._marshal_pk_grouped(sets, plan, raw=True)
+            with self.observer.stage("marshal"):
+                marshalled = self._marshal_pk_grouped(sets, plan, raw=True)
             if marshalled is None:
                 return None
             g, sig_raw = marshalled
-            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
-            return self.kernels.verify_pk_grouped_raw(g, sig_raw, a_bits, b_bits)
-        g = self._marshal_pk_grouped(sets, plan)
+            with self.observer.stage("rand"):
+                a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            with self.observer.stage("dispatch"):
+                return self.kernels.verify_pk_grouped_raw(
+                    g, sig_raw, a_bits, b_bits
+                )
+        with self.observer.stage("marshal"):
+            g = self._marshal_pk_grouped(sets, plan)
         if g is None:
             return None
-        a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
-        return self.kernels.verify_pk_grouped(g, a_bits, b_bits)
+        with self.observer.stage("rand"):
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        with self.observer.stage("dispatch"):
+            return self.kernels.verify_pk_grouped(g, a_bits, b_bits)
 
     def _marshal(self, sets, raw: bool = False):
         """Build padded device arrays; None if any set is invalid up front.
@@ -1074,18 +1124,20 @@ class TpuBlsVerifier:
         if sets and self._native_eligible(sets):
             plan = self._plan_groups(sets)
             if plan is not None:
+                t = time.monotonic()
                 result = self._submit_grouped(sets, plan)
                 if result is None:
                     return lambda: False
-                return lambda: bool(result)
+                return lambda: self._resolve(result, t)
             # roots don't group — try the DUAL axis: pubkeys repeat in
             # any adversarial unique-root flood (bounded attacker keys)
             pk_plan = self._plan_pk_groups(sets)
             if pk_plan is not None:
+                t = time.monotonic()
                 result = self._submit_pk_grouped(sets, pk_plan)
                 if result is None:
                     return lambda: False
-                return lambda: bool(result)
+                return lambda: self._resolve(result, t)
             # mixed batch: peel the shared-root sets onto the grouped
             # kernel; the singleton remainder tries pk-grouping before
             # paying the per-set kernel
@@ -1094,6 +1146,9 @@ class TpuBlsVerifier:
                 shared_sets = [sets[i] for i in shared]
                 sub_plan = self._plan_groups(shared_sets)
                 if sub_plan is not None:
+                    # the peeled parts also count under their own paths
+                    self.observer.planner("split", len(sets))
+                    t = time.monotonic()
                     grouped_res = self._submit_grouped(shared_sets, sub_plan)
                     if grouped_res is None:
                         return lambda: False
@@ -1103,59 +1158,103 @@ class TpuBlsVerifier:
                         pk_res = self._submit_pk_grouped(unique_sets, pk_plan)
                         if pk_res is None:
                             return lambda: False
-                        return lambda: bool(grouped_res) and bool(pk_res)
+                        return lambda: (
+                            self._resolve(grouped_res, t)
+                            and self._resolve(pk_res, t)
+                        )
                     flat = self._submit_flat(unique_sets)
-                    return lambda: bool(grouped_res) and flat()
+                    return lambda: self._resolve(grouped_res, t) and flat()
         return self._submit_flat(sets)
+
+    def _resolve(self, result, t_submit: float | None = None) -> bool:
+        """Block on one device verdict, timing the wait (`device_wait`
+        stage) and feeding the busy-fraction sampler with the full
+        submit→resolve span (the device computes through the async gap,
+        so resolver block time alone undercounts occupancy)."""
+        t0 = time.monotonic()
+        verdict = bool(result)
+        now = time.monotonic()
+        self.observer.observe_stage("device_wait", now - t0)
+        self.observer.device_busy_sample(
+            now - (t_submit if t_submit is not None else t0)
+        )
+        return verdict
 
     def _submit_grouped(self, sets, plan):
         """Dispatch one grouped-kernel batch; None marks an invalid set
         (caller reports False)."""
+        self.observer.planner(
+            "root_grouped", len(sets), group_sizes=[len(r) for r in plan[2]]
+        )
         if self._device_decompress:
-            marshalled = self._marshal_grouped(sets, plan, raw=True)
+            with self.observer.stage("marshal"):
+                marshalled = self._marshal_grouped(sets, plan, raw=True)
             if marshalled is None:
                 return None
             g, sig_raw = marshalled
-            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
-            return self.kernels.verify_grouped_raw(g, sig_raw, a_bits, b_bits)
-        g = self._marshal_grouped(sets, plan)
+            with self.observer.stage("rand"):
+                a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            with self.observer.stage("dispatch"):
+                return self.kernels.verify_grouped_raw(
+                    g, sig_raw, a_bits, b_bits
+                )
+        with self.observer.stage("marshal"):
+            g = self._marshal_grouped(sets, plan)
         if g is None:
             return None
-        a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
-        return self.kernels.verify_grouped(g, a_bits, b_bits)
+        with self.observer.stage("rand"):
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        with self.observer.stage("dispatch"):
+            return self.kernels.verify_grouped(g, a_bits, b_bits)
 
     def _submit_flat(self, sets):
         """Per-set kernel dispatch (chunked to the largest bucket);
         resolver ANDs the chunk verdicts — all-or-nothing, same as one
         dispatch."""
+        if sets:
+            self.observer.planner("per_set", len(sets))
         cap = self.kernels.buckets[-1]
         use_raw = self._device_decompress and self._native_eligible(sets)
         results = []
+        t_submit = time.monotonic()
         for lo in range(0, max(len(sets), 1), cap):
             chunk = sets[lo : lo + cap]
             if use_raw:
-                marshalled = self._marshal(chunk, raw=True)
+                with self.observer.stage("marshal"):
+                    marshalled = self._marshal(chunk, raw=True)
                 if marshalled is None:
                     return lambda: False
                 arrs, sig_raw = marshalled
-                r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
-                results.append(
-                    self.kernels.verify_batch_raw(arrs, sig_raw, r_bits)
-                )
+                with self.observer.stage("rand"):
+                    r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
+                with self.observer.stage("dispatch"):
+                    results.append(
+                        self.kernels.verify_batch_raw(arrs, sig_raw, r_bits)
+                    )
                 continue
-            arrs = self._marshal(chunk)
+            with self.observer.stage("marshal"):
+                arrs = self._marshal(chunk)
             if arrs is None:
                 return lambda: False
-            r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
-            results.append(self.kernels.verify_batch(arrs, r_bits))
-        return lambda: all(bool(r) for r in results)
+            with self.observer.stage("rand"):
+                r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
+            with self.observer.stage("dispatch"):
+                results.append(self.kernels.verify_batch(arrs, r_bits))
+        return lambda: all(self._resolve(r, t_submit) for r in results)
 
     def verify_signature_sets_individual(self, sets) -> list[bool]:
-        arrs = self._marshal(sets)
+        self.observer.planner("individual", len(sets))
+        with self.observer.stage("marshal"):
+            arrs = self._marshal(sets)
         if arrs is None:
             # mirror reference behavior: individually report malformed as False
             return [self._verify_one(s) for s in sets]
-        out = np.asarray(self.kernels.verify_individual(arrs))
+        t = time.monotonic()
+        with self.observer.stage("dispatch"):
+            result = self.kernels.verify_individual(arrs)
+        with self.observer.stage("device_wait"):
+            out = np.asarray(result)
+        self.observer.device_busy_sample(time.monotonic() - t)
         return [bool(v) for v in out[: arrs.n]]
 
     def _verify_one(self, s) -> bool:

@@ -111,3 +111,77 @@ def test_non_batchable_bypasses_buffer():
     assert asyncio.run(run())
     assert inner.batch_calls == 1
     assert len(buffered._buffer) == 0
+
+
+def test_flush_reason_counters_and_queue_gauge_transitions():
+    """Size- vs timer-triggered flushes land on distinct counter series
+    and the live buffer-depth gauge (callback, no polling) tracks the
+    queue through both (ISSUE 1 queue observability)."""
+    from lodestar_tpu.metrics import create_beacon_metrics
+
+    inner = CountingVerifier()
+    m = create_beacon_metrics()
+    buffered = BufferedVerifier(inner, prom=m)
+    pipeline = m.pipeline
+    assert buffered.pipeline is pipeline  # inherited from the prom bundle
+
+    async def run():
+        a = asyncio.create_task(buffered.verify(_sets(2), batchable=True))
+        await asyncio.sleep(0)
+        assert pipeline.buffer_depth.value() == 2  # gauge went up
+        # crossing MAX_BUFFERED_SIGS flushes immediately: reason=size
+        b = asyncio.create_task(
+            buffered.verify(_sets(MAX_BUFFERED_SIGS, salt=100), batchable=True)
+        )
+        await asyncio.sleep(0)
+        ra, rb = await asyncio.gather(a, b)
+        assert pipeline.buffer_depth.value() == 0  # ...and back down
+        assert pipeline.flushes.value(reason="size") == 1
+        assert pipeline.flushes.value(reason="timer") == 0
+        # a lone sub-threshold request drains at the wait window: timer
+        c = asyncio.create_task(buffered.verify(_sets(1, salt=200), batchable=True))
+        await asyncio.sleep(0)
+        assert pipeline.buffer_depth.value() == 1
+        rc = await c
+        assert pipeline.buffer_depth.value() == 0
+        assert pipeline.flushes.value(reason="timer") == 1
+        return ra, rb, rc
+
+    assert asyncio.run(run()) == (True, True, True)
+    assert pipeline.flush_seconds._totals[()] == 2  # flush latency observed
+
+
+def test_device_tier_telemetry_through_thread_buffered_facade():
+    """Real-kernel twin of the stubbed acceptance test in
+    tests/test_observability.py: verify_signature_sets through
+    ThreadBufferedVerifier over DeviceBlsVerifier on the CPU fallback
+    updates a stage histogram, the planner-path counter and the
+    queue-depth gauge, all visible in the /metrics text exposition."""
+    from lodestar_tpu.chain.bls_verifier import (
+        DeviceBlsVerifier,
+        ThreadBufferedVerifier,
+    )
+    from lodestar_tpu.metrics import create_beacon_metrics
+
+    m = create_beacon_metrics()
+    dev = DeviceBlsVerifier(buckets=(4, 8), observer=m.pipeline)
+    tbv = ThreadBufferedVerifier(dev, max_sigs=8, max_wait_ms=50, prom=m)
+    # distinct roots AND keys: the planner routes the per-set kernel
+    assert tbv.verify_signature_sets(_sets(3), batchable=True)
+
+    assert m.pipeline.flushes.value(reason="timer") == 1
+    assert m.pipeline.planner_decisions.value(path="per_set") == 1
+    snap = m.pipeline.stage_snapshot()
+    assert snap["marshal"]["count"] >= 1
+    assert snap["dispatch"]["count"] >= 1
+    assert snap["device_wait"]["count"] >= 1
+
+    text = m.registry.expose()
+    assert "lodestar_bls_pipeline_stage_seconds_bucket" in text
+    assert 'stage="device_wait"' in text
+    assert (
+        'lodestar_bls_verifier_planner_decisions_total{path="per_set"} 1'
+        in text
+    )
+    assert "lodestar_bls_verifier_buffer_depth 0" in text
+    assert 'lodestar_bls_verifier_flushes_total{reason="timer"} 1' in text

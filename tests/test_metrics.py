@@ -35,6 +35,54 @@ def test_histogram_timer():
     assert h._totals[()] == 1
 
 
+def test_histogram_timer_with_labels():
+    r = MetricsRegistry()
+    h = r.histogram("stage_seconds", "s", label_names=("stage",))
+    with h.time(stage="marshal"):
+        pass
+    with h.time(stage="marshal"):
+        pass
+    with h.time(stage="dispatch"):
+        pass
+    text = r.expose()
+    assert 'stage_seconds_count{stage="marshal"} 2' in text
+    assert 'stage_seconds_count{stage="dispatch"} 1' in text
+
+
+def test_summary_exposition_format():
+    r = MetricsRegistry()
+    s = r.summary("batch_size", "sets per batch")
+    s.observe(10)
+    s.observe(30)
+    assert s.sum() == 40 and s.count() == 2
+    labeled = r.summary("wait_seconds", "w", label_names=("kind",))
+    with labeled.time(kind="gossip"):
+        pass
+    text = r.expose()
+    assert "# TYPE batch_size summary" in text
+    assert "batch_size_sum 40" in text
+    assert "batch_size_count 2" in text
+    assert 'wait_seconds_count{kind="gossip"} 1' in text
+    # summaries never emit bucket series
+    assert "batch_size_bucket" not in text
+
+
+def test_gauge_func_callback():
+    r = MetricsRegistry()
+    depth = [0]
+    g = r.gauge_func("queue_depth", "live depth", fn=lambda: depth[0])
+    assert g.value() == 0
+    depth[0] = 7
+    assert "queue_depth 7" in r.expose()  # read at collection time
+    # late binding + broken-callback safety
+    g.set_function(lambda: 1 / 0)
+    assert g.value() == 0.0
+    unbound = r.gauge_func("other_depth", "no fn yet")
+    assert unbound.value() == 0.0
+    unbound.set_function(lambda: 3)
+    assert "other_depth 3" in r.expose()
+
+
 def test_beacon_metric_set_and_http_server():
     m = create_beacon_metrics()
     m.head_slot.set(7)

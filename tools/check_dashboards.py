@@ -1,0 +1,107 @@
+"""Lint dashboards against the live metric registry.
+
+Every metric name referenced by a panel expression in `dashboards/*.json`
+must exist in the default node registry (create_beacon_metrics +
+ValidatorMonitor + GcMetrics) — a dashboard panel over a metric nothing
+emits is the bug this repo shipped for five rounds (ISSUE 1). The reverse
+direction — registry families no dashboard plots — is REPORTED but not a
+failure: breadth families land before their dashboards do.
+
+Exit code 0 = every dashboard name resolves; 1 = at least one panel
+references an unknown metric. Run directly or via the tier-1 test
+(tests/test_metrics.py::test_check_dashboards_lint_passes).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+# PromQL functions/keywords that appear inside panel expressions
+PROMQL_WORDS = {
+    "rate", "irate", "sum", "avg", "min", "max", "count", "by", "on",
+    "histogram_quantile", "increase", "delta", "label_replace", "time",
+    "without", "group_left", "group_right", "clamp_max", "clamp_min",
+}
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def registry_names() -> set[str]:
+    """Every series name the default full-node registry can expose."""
+    sys.path.insert(0, REPO_ROOT)
+    from lodestar_tpu.metrics.beacon import create_beacon_metrics
+    from lodestar_tpu.metrics.gc_stats import GcMetrics
+    from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+
+    m = create_beacon_metrics()
+    ValidatorMonitor(m.registry)
+    GcMetrics(m.registry)
+    known: set[str] = set()
+    families: set[str] = set()
+    for metric in m.registry._metrics:
+        families.add(metric.name)
+        known.add(metric.name)
+        if metric.kind == "histogram":
+            known |= {metric.name + s for s in ("_bucket", "_sum", "_count")}
+        elif metric.kind == "summary":
+            known |= {metric.name + s for s in ("_sum", "_count")}
+    return known, families
+
+
+def dashboard_refs(dash_dir: str):
+    """Yield (file, panel_title, metric_name) for every name-shaped token
+    in every panel expression."""
+    for path in sorted(glob.glob(os.path.join(dash_dir, "*.json"))):
+        doc = json.load(open(path))
+        for panel in doc.get("panels", []):
+            for target in panel.get("targets", []):
+                for name in re.findall(r"[a-z][a-z0-9_]{3,}", target["expr"]):
+                    if name in PROMQL_WORDS:
+                        continue
+                    yield os.path.basename(path), panel.get("title", "?"), name
+
+
+def main(argv=None) -> int:
+    dash_dir = os.path.join(REPO_ROOT, "dashboards")
+    if argv and len(argv) > 1:
+        dash_dir = argv[1]
+    known, families = registry_names()
+
+    missing = []
+    referenced_families: set[str] = set()
+    for fname, title, name in dashboard_refs(dash_dir):
+        if name in known:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in families:
+                    name = name[: -len(suffix)]
+                    break
+            referenced_families.add(name)
+        else:
+            missing.append((fname, title, name))
+
+    for fname, title, name in missing:
+        print(f"MISSING {name}  ({fname} :: {title})")
+    unexported = sorted(families - referenced_families)
+    if unexported:
+        print(
+            f"note: {len(unexported)} registry families not plotted by any "
+            "dashboard (informational):"
+        )
+        for name in unexported:
+            print(f"  unplotted {name}")
+    if missing:
+        print(f"FAIL: {len(missing)} dashboard references missing from the registry")
+        return 1
+    print(
+        f"OK: every dashboard metric resolves "
+        f"({len(referenced_families)}/{len(families)} families plotted)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
