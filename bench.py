@@ -224,8 +224,14 @@ def _bench_e2e() -> dict | None:
     _rand_pairs(g.valid.shape)
     marshal_warm_s = time.perf_counter() - t0
     best = min(d for d in (dt, dt_raw) if d is not None)
+    # trend-line stability (ADVICE round 5): the headline e2e key stays
+    # bound to the HOST-MARSHAL path rounds 1-4 reported, so cross-round
+    # comparisons (tools/bench_compare.py) never silently compare
+    # different configurations; the best-of-variants rate gets its own
+    # key instead of redefining the old one
     return {
-        "e2e_wire_to_verdict_sets_per_sec": round(batch / best, 2),
+        "e2e_wire_to_verdict_sets_per_sec": round(batch / dt, 2),
+        "e2e_best_sets_per_sec": round(batch / best, 2),
         "e2e_host_marshal_sets_per_sec": round(batch / dt, 2),
         **rows,
         "marshal_sets_per_sec_warm_1core": round(batch / marshal_warm_s, 2),
@@ -427,7 +433,12 @@ def main() -> None:
 
     _log("bench: e2e phase...")
     with em.phase("e2e", deadline_s=deadline) as ph:
-        ph.update(_bench_e2e() or {})
+        e2e_rows = _bench_e2e() or {}
+        ph.update(e2e_rows)
+        if "e2e_best_sets_per_sec" in e2e_rows:
+            # promoted top-level key (ADVICE round 5): best-of-variants
+            # e2e rate, separate from the round-4-comparable headline
+            em.extra["e2e_best_sets_per_sec"] = e2e_rows["e2e_best_sets_per_sec"]
 
     _log("bench: stage-profile phase...")
     with em.phase("stage_profile", deadline_s=deadline) as ph:

@@ -26,6 +26,7 @@ from ..state_transition.signature_sets import get_block_signature_sets
 from ..state_transition.stf import state_transition
 from ..state_transition import util as st_util
 from ..fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
+from ..observability import spans as _spans
 from .bls_verifier import CpuBlsVerifier, IBlsVerifier
 from .clock import BeaconClock, ManualClock
 from .op_pools import (
@@ -58,8 +59,10 @@ def _verify_now(verifier, sets) -> bool:
     instances of the class) — not by catching TypeError around the live
     call, which would swallow a genuine TypeError raised inside
     verification (malformed set contents) and silently re-run the whole
-    batch. Only an explicit `batchable` parameter counts: every facade
-    in this repo declares it explicitly (chain/bls_verifier.py)."""
+    batch. An explicit `batchable` parameter counts, and so does a
+    `**kwargs` catch-all (ADVICE round 5): a thin wrapper/decorator that
+    forwards keyword arguments to a batching facade must receive
+    batchable=False, not silently fall into the wait-window path."""
     fn = verifier.verify_signature_sets
     key = getattr(fn, "__func__", fn)
     supports = _VERIFY_NOW_SUPPORT.get(key)
@@ -67,7 +70,11 @@ def _verify_now(verifier, sets) -> bool:
         import inspect
 
         try:
-            supports = "batchable" in inspect.signature(fn).parameters
+            params = inspect.signature(fn).parameters
+            supports = "batchable" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
         except (ValueError, TypeError):  # builtins without signatures
             supports = False
         _VERIFY_NOW_SUPPORT[key] = supports
@@ -237,10 +244,27 @@ class BeaconChain:
         if block.slot <= finalized_slot:
             raise BlockImportError("block slot not after finalized")
 
+        # the lifecycle span: child of the gossip trace when one is
+        # active, its own root trace on direct imports (REST publish,
+        # unknown-block fetch) — either way one correlated trace per block
+        with _spans.tracer.span(
+            "chain/process_block",
+            slot=int(block.slot),
+            root=block_root.hex(),
+        ):
+            return self._process_block_spanned(
+                signed_block, block_root, verify_signatures
+            )
+
+    def _process_block_spanned(
+        self, signed_block, block_root: bytes, verify_signatures: bool
+    ):
+        block = signed_block.message
         # pre-state (advanced to the block's slot: its epoch context covers
         # the block's committees/proposer, so signature sets can be built
         # BEFORE the state transition — the key to the 3-way overlap)
-        pre = self._get_pre_state(signed_block)
+        with _spans.tracer.span("chain/pre_state"):
+            pre = self._get_pre_state(signed_block)
 
         # 3-way parallel verification (reference verifyBlock.ts:69-80:
         # state transition ∥ BLS signatures ∥ execution payload). The
@@ -252,23 +276,26 @@ class BeaconChain:
         m = getattr(self, "metrics", None)
         fut_sig = fut_payload = None
         t_start = _time.monotonic()
+        # worker threads don't inherit contextvars: hand them the live span
+        trace_ctx = _spans.tracer.context()
         if verify_signatures:
             sets = get_block_signature_sets(pre, self.types, signed_block)
             # block import is latency-critical: verify immediately rather
             # than sitting in a batching facade's wait window
             fut_sig = self._verify_pool.submit(
-                _verify_now, self.bls, sets
+                self._verify_now_traced, trace_ctx, sets
             )
         fut_payload = self._verify_pool.submit(
-            self._verify_execution_payload, pre, signed_block
+            self._verify_execution_payload_traced, trace_ctx, pre, signed_block
         )
 
         try:
             post = pre.copy()
-            state_transition(
-                post, self.types, signed_block,
-                verify_state_root=True, verify_signatures=False,
-            )
+            with _spans.tracer.span("chain/state_transition"):
+                state_transition(
+                    post, self.types, signed_block,
+                    verify_state_root=True, verify_signatures=False,
+                )
             t_stf = _time.monotonic()
             if m is not None:
                 m.block_stf_seconds.observe(t_stf - t_start)
@@ -276,6 +303,8 @@ class BeaconChain:
                 if m is not None:
                     m.block_import_errors_total.inc(reason="signature")
                 raise BlockImportError("block signature set verification failed")
+            if fut_sig is not None:
+                self._record_milestone("sigs_verified", block.slot)
             t_sig = _time.monotonic()
             if m is not None and fut_sig is not None:
                 # wait beyond the STF, i.e. the non-overlapped signature tail
@@ -300,6 +329,28 @@ class BeaconChain:
         self._import_block(signed_block, block_root, post, payload_status)
         return block_root
 
+    def _verify_now_traced(self, trace_ctx, sets) -> bool:
+        """_verify_now on a pool worker, attached to the caller's trace so
+        the signature batch appears as a `chain/bls_verify` span."""
+        with _spans.tracer.attach(trace_ctx), _spans.tracer.span(
+            "chain/bls_verify", sets=len(sets)
+        ):
+            return _verify_now(self.bls, sets)
+
+    def _verify_execution_payload_traced(self, trace_ctx, pre, signed_block):
+        with _spans.tracer.attach(trace_ctx), _spans.tracer.span(
+            "chain/execution_payload"
+        ):
+            return self._verify_execution_payload(pre, signed_block)
+
+    def _record_milestone(self, milestone: str, slot) -> None:
+        """Slot-milestone delay, recorded only for blocks of the CURRENT
+        clock slot: range-sync imports of historic blocks would flood the
+        histogram's +Inf bucket with hours-old 'delays' and bury the
+        live-following signal the metric exists for."""
+        if int(slot) == self.clock.current_slot:
+            _spans.record_slot_milestone(self, milestone, slot)
+
     def process_block_segment(self, signed_blocks, verify_signatures: bool = True):
         """Import a range-sync segment with ONE batched signature dispatch.
 
@@ -320,6 +371,15 @@ class BeaconChain:
             return self._process_segment_locked(signed_blocks, verify_signatures)
 
     def _process_segment_locked(self, signed_blocks, verify_signatures: bool):
+        signed_blocks = list(signed_blocks)
+        with _spans.tracer.span(
+            "chain/process_segment", blocks=len(signed_blocks)
+        ):
+            return self._process_segment_spanned(
+                signed_blocks, verify_signatures
+            )
+
+    def _process_segment_spanned(self, signed_blocks, verify_signatures: bool):
         import time as _time
 
         m = getattr(self, "metrics", None)
@@ -360,10 +420,13 @@ class BeaconChain:
             )
             t0 = _time.monotonic()
             post = pre.copy()
-            state_transition(
-                post, self.types, signed,
-                verify_state_root=True, verify_signatures=False,
-            )
+            with _spans.tracer.span(
+                "chain/state_transition", slot=int(block.slot)
+            ):
+                state_transition(
+                    post, self.types, signed,
+                    verify_state_root=True, verify_signatures=False,
+                )
             if m is not None:
                 m.block_stf_seconds.observe(_time.monotonic() - t0)
             pending.append((signed, root, post, fut_payload))
@@ -372,10 +435,16 @@ class BeaconChain:
         try:
             if verify_signatures and all_sets:
                 t0 = _time.monotonic()
-                if not _verify_now(self.bls, all_sets):
+                with _spans.tracer.span("chain/bls_verify", sets=len(all_sets)):
+                    batch_ok = _verify_now(self.bls, all_sets)
+                if not batch_ok:
                     if m is not None:
                         m.block_import_errors_total.inc(reason="signature")
                     raise BlockImportError("segment signature batch failed")
+                if pending:
+                    self._record_milestone(
+                        "sigs_verified", pending[-1][0].message.slot
+                    )
                 if m is not None:
                     m.block_sig_seconds.observe(_time.monotonic() - t0)
         except BaseException:
@@ -481,88 +550,113 @@ class BeaconChain:
     def _import_block(
         self, signed_block, block_root: bytes, post, payload_status=None
     ) -> None:
+        with _spans.tracer.span(
+            "chain/import",
+            slot=int(signed_block.message.slot),
+            root=block_root.hex(),
+        ):
+            self._import_block_spanned(
+                signed_block, block_root, post, payload_status
+            )
+
+    def _import_block_spanned(
+        self, signed_block, block_root: bytes, post, payload_status=None
+    ) -> None:
         block = signed_block.message
         state = post.state
         prev_finalized = self.fork_choice.store.finalized_checkpoint[0]
-        # fork choice
-        self.fork_choice.update_time(max(self.clock.current_slot, block.slot))
-        # unrealized checkpoints: what FFG would reach if the epoch ended
-        # now — feeds tip pull-up + prior-epoch viability (reference
-        # forkChoice.ts:406-453 via computeUnrealizedCheckpoints)
-        try:
-            from ..state_transition.unrealized import compute_unrealized_checkpoints
-
-            unrealized_j, unrealized_f = compute_unrealized_checkpoints(
-                post, self.types
-            )
-        except Exception:
-            # degrading to realized checkpoints keeps import alive, but
-            # silently losing pull-up would be undiagnosable — log it
-            import logging
-
-            logging.getLogger(__name__).exception(
-                "compute_unrealized_checkpoints failed; using realized"
-            )
-            unrealized_j = unrealized_f = None
         # timeliness for the proposer boost: seconds since the block's
         # slot started, at import time
         block_delay = self.clock.time_fn() - self.clock.time_at_slot(block.slot)
-        self.fork_choice.on_block(
-            block.slot,
-            block_root,
-            bytes(block.parent_root),
-            bytes(block.state_root),
-            (
-                state.current_justified_checkpoint.epoch,
-                bytes(state.current_justified_checkpoint.root),
-            ),
-            (
-                state.finalized_checkpoint.epoch,
-                bytes(state.finalized_checkpoint.root),
-            ),
-            justified_balances=post.flat.effective_balance.astype(np.int64),
-            unrealized_justified_checkpoint=unrealized_j,
-            unrealized_finalized_checkpoint=unrealized_f,
-            block_delay_sec=block_delay,
-            execution_status=_exec_status_for_fork_choice(payload_status, post),
-        )
-        if payload_status is not None and str(
-            getattr(payload_status, "value", payload_status)
-        ) == "VALID":
-            # a VALID verdict confirms every optimistic ancestor too
-            self.fork_choice.proto.set_execution_valid(block_root)
-        # per-attestation fork-choice votes (importBlock.ts:88-130)
-        monitor = getattr(self, "validator_monitor", None)
-        monitored = monitor.monitored if monitor is not None else set()
-        for att in block.body.attestations:
+        with _spans.tracer.span("chain/fork_choice"):
+            self.fork_choice.update_time(
+                max(self.clock.current_slot, block.slot)
+            )
+            # unrealized checkpoints: what FFG would reach if the epoch
+            # ended now — feeds tip pull-up + prior-epoch viability
+            # (reference forkChoice.ts:406-453)
             try:
-                indices = get_attesting_indices(
-                    post, att.data, att.aggregation_bits
+                from ..state_transition.unrealized import (
+                    compute_unrealized_checkpoints,
                 )
-                self.fork_choice.on_attestation(
-                    indices, bytes(att.data.beacon_block_root), att.data.target.epoch
+
+                unrealized_j, unrealized_f = compute_unrealized_checkpoints(
+                    post, self.types
                 )
-                if monitored and monitored.intersection(int(i) for i in indices):
-                    spe = self.preset.SLOTS_PER_EPOCH
-                    target_root = self.fork_choice.get_ancestor(
-                        block_root, int(att.data.target.epoch) * spe
-                    )
-                    head_at_slot = self.fork_choice.get_ancestor(
-                        block_root, int(att.data.slot)
-                    )
-                    monitor.on_attestation_included(
-                        int(att.data.target.epoch),
-                        indices,
-                        int(block.slot) - int(att.data.slot),
-                        target_correct=target_root == bytes(att.data.target.root),
-                        head_correct=head_at_slot
-                        == bytes(att.data.beacon_block_root),
-                    )
             except Exception:
-                continue
+                # degrading to realized checkpoints keeps import alive, but
+                # silently losing pull-up would be undiagnosable — log it
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "compute_unrealized_checkpoints failed; using realized"
+                )
+                unrealized_j = unrealized_f = None
+            self.fork_choice.on_block(
+                block.slot,
+                block_root,
+                bytes(block.parent_root),
+                bytes(block.state_root),
+                (
+                    state.current_justified_checkpoint.epoch,
+                    bytes(state.current_justified_checkpoint.root),
+                ),
+                (
+                    state.finalized_checkpoint.epoch,
+                    bytes(state.finalized_checkpoint.root),
+                ),
+                justified_balances=post.flat.effective_balance.astype(np.int64),
+                unrealized_justified_checkpoint=unrealized_j,
+                unrealized_finalized_checkpoint=unrealized_f,
+                block_delay_sec=block_delay,
+                execution_status=_exec_status_for_fork_choice(
+                    payload_status, post
+                ),
+            )
+            if payload_status is not None and str(
+                getattr(payload_status, "value", payload_status)
+            ) == "VALID":
+                # a VALID verdict confirms every optimistic ancestor too
+                self.fork_choice.proto.set_execution_valid(block_root)
+            # per-attestation fork-choice votes (importBlock.ts:88-130)
+            monitor = getattr(self, "validator_monitor", None)
+            monitored = monitor.monitored if monitor is not None else set()
+            for att in block.body.attestations:
+                try:
+                    indices = get_attesting_indices(
+                        post, att.data, att.aggregation_bits
+                    )
+                    self.fork_choice.on_attestation(
+                        indices,
+                        bytes(att.data.beacon_block_root),
+                        att.data.target.epoch,
+                    )
+                    if monitored and monitored.intersection(
+                        int(i) for i in indices
+                    ):
+                        spe = self.preset.SLOTS_PER_EPOCH
+                        target_root = self.fork_choice.get_ancestor(
+                            block_root, int(att.data.target.epoch) * spe
+                        )
+                        head_at_slot = self.fork_choice.get_ancestor(
+                            block_root, int(att.data.slot)
+                        )
+                        monitor.on_attestation_included(
+                            int(att.data.target.epoch),
+                            indices,
+                            int(block.slot) - int(att.data.slot),
+                            target_correct=target_root
+                            == bytes(att.data.target.root),
+                            head_correct=head_at_slot
+                            == bytes(att.data.beacon_block_root),
+                        )
+                except Exception:
+                    continue
         if monitored:
             epoch = int(block.slot) // self.preset.SLOTS_PER_EPOCH
-            monitor.on_block_proposed(epoch, int(block.proposer_index))
+            monitor.on_block_proposed(
+                epoch, int(block.proposer_index), delay_sec=block_delay
+            )
             agg = getattr(block.body, "sync_aggregate", None)
             if agg is not None:
                 pk_to_idx = post.epoch_ctx.pubkey_to_index
@@ -594,10 +688,12 @@ class BeaconChain:
         self.db.block.put(block_root, signed_block)
         self.state_cache.add(state.hash_tree_root(), post, block_root=block_root)
         self.seen_block_proposers.add(block.slot, block.proposer_index)
+        self._record_milestone("imported", block.slot)
         prev_head = self.head_root
         self.head_state = post
-        self.update_head()
-        self._notify_forkchoice_to_engine()
+        with _spans.tracer.span("chain/head_update"):
+            self.update_head()
+            self._notify_forkchoice_to_engine()
         from .emitter import ChainEvent
 
         self.emitter.emit(
@@ -605,6 +701,7 @@ class BeaconChain:
             {"slot": str(int(block.slot)), "block": "0x" + block_root.hex()},
         )
         if self.head_root != prev_head:
+            self._record_milestone("head_updated", block.slot)
             # block.state_root is the imported state's verified root — no
             # re-merkleization on the import hot path
             state_root = (

@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from enum import Enum
 
+from ..observability import spans as _spans
 from ..state_transition import util as st_util
 from ..state_transition.signature_sets import indexed_attestation_signature_set
 
@@ -116,7 +117,11 @@ def validate_gossip_attestation(
             signature=bytes(attestation.signature),
         ),
     )
-    if not chain.bls.verify_signature_sets([sig_set]):
+    with _spans.tracer.span(
+        "validation/bls_verify", sets=1, slot=int(data.slot)
+    ):
+        sig_ok = chain.bls.verify_signature_sets([sig_set])
+    if not sig_ok:
         return ValidationResult(GossipAction.REJECT, "invalid signature")
 
     # re-check seen after the async verify (reference double-checks at
@@ -192,7 +197,8 @@ def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
     from ..state_transition.signature_sets import block_proposer_signature_set
 
     try:
-        state = chain.regen.get_pre_state(block)
+        with _spans.tracer.span("validation/regen", slot=int(block.slot)):
+            state = chain.regen.get_pre_state(block)
     except Exception:
         return ValidationResult(GossipAction.IGNORE, "cannot regen parent state")
     if state.epoch_ctx.get_beacon_proposer(block.slot) != int(block.proposer_index):
@@ -203,7 +209,9 @@ def validate_gossip_block(chain, types, signed_block) -> ValidationResult:
         # never sit out a batching facade's wait window
         from .chain import _verify_now
 
-        if not _verify_now(chain.bls, [sig_set]):
+        with _spans.tracer.span("validation/bls_verify", sets=1):
+            sig_ok = _verify_now(chain.bls, [sig_set])
+        if not sig_ok:
             return ValidationResult(GossipAction.REJECT, "invalid proposer signature")
     except Exception:
         return ValidationResult(GossipAction.IGNORE, "cannot build signature set")
@@ -308,7 +316,11 @@ def validate_gossip_aggregate_and_proof(chain, types, signed_agg) -> ValidationR
         signature=bytes(signed_agg.signature),
     )
     att_set = attestation_signature_set(target_state, types, attestation)
-    if not chain.bls.verify_signature_sets([sel_set, env_set, att_set]):
+    with _spans.tracer.span(
+        "validation/bls_verify", sets=3, slot=int(data.slot)
+    ):
+        sigs_ok = chain.bls.verify_signature_sets([sel_set, env_set, att_set])
+    if not sigs_ok:
         return ValidationResult(GossipAction.REJECT, "invalid signatures")
 
     # re-check after the (batched, possibly awaited) verification so a

@@ -1,14 +1,18 @@
 """/metrics HTTP endpoint (reference: `metrics/server/http.ts`) plus the
-profiler control surface:
+profiler + lifecycle-trace control surface:
 
     GET /metrics          Prometheus text exposition
     POST /profiler/start  start an XLA profiler trace (?dir=<path>)
     POST /profiler/stop   stop it; returns the trace directory
+    GET /debug/traces     recent lifecycle traces as JSON
+                          (?slot=N &root=0x… &limit=K)
 
 (GET also accepted on the profiler routes — operator curl ergonomics.)
 The profiler hooks default to `observability.trace`, the same process-
 wide switch the device verifier uses, so the endpoint and
-LODESTAR_TPU_PROFILE cannot double-start a trace.
+LODESTAR_TPU_PROFILE cannot double-start a trace. `/debug/traces` reads
+the `observability.spans` ring buffer — the gossip-wire→head-update
+span layer — newest first.
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ class MetricsServer:
         port: int = 0,
         profiler_start=None,
         profiler_stop=None,
+        tracer=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -34,6 +39,10 @@ class MetricsServer:
 
             profiler_start = profiler_start or trace.start_profiling
             profiler_stop = profiler_stop or trace.stop_profiling
+        if tracer is None:
+            from ..observability import spans
+
+            tracer = spans.tracer
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -71,6 +80,32 @@ class MetricsServer:
                         )
                     else:
                         self._send_json(200, {"status": "stopped", "dir": stopped})
+                    return
+                if route == "/debug/traces":
+                    q = urllib.parse.parse_qs(parsed.query)
+
+                    def _one(key):
+                        return (q.get(key) or [None])[0]
+
+                    slot = _one("slot")
+                    try:
+                        slot = int(slot) if slot is not None else None
+                        limit = min(int(_one("limit") or 64), 256)
+                    except ValueError:
+                        self._send_json(400, {"error": "bad slot/limit"})
+                        return
+                    docs = tracer.traces(
+                        slot=slot, root=_one("root"), limit=limit
+                    )
+                    self._send_json(
+                        200,
+                        {
+                            "count": len(docs),
+                            "completed_total": tracer.completed_total,
+                            "enabled": tracer.enabled,
+                            "traces": docs,
+                        },
+                    )
                     return
                 if route not in ("", "/metrics"):
                     self.send_response(404)

@@ -98,6 +98,20 @@ class ValidatorMonitor:
                     "latest monitored validator balance",
                     label_names=label,
                 ),
+                # timeliness: delay from the duty's slot start to the
+                # event reaching this node (reference validatorMonitor
+                # *_delay_seconds families — the per-validator view of
+                # the node-wide slot-milestone metrics)
+                "att_delay": registry.histogram(
+                    "validator_monitor_attestation_seen_delay_seconds",
+                    "slot-start -> gossip-seen delay of monitored attestations",
+                    buckets=(0.5, 1, 1.5, 2, 3, 4, 6, 8, 12),
+                ),
+                "block_delay": registry.histogram(
+                    "validator_monitor_block_seen_delay_seconds",
+                    "slot-start -> import delay of monitored proposals",
+                    buckets=(0.5, 1, 1.5, 2, 3, 4, 6, 8, 12),
+                ),
             }
 
     def register_validator(self, index: int) -> None:
@@ -124,6 +138,7 @@ class ValidatorMonitor:
                 s.attestation_seen_delay_sec = delay_sec
                 if self._metrics:
                     self._metrics["seen"].inc(index=str(index))
+                    self._metrics["att_delay"].observe(delay_sec)
 
     def on_attestation_included(
         self, epoch: int, indices, inclusion_distance: int,
@@ -170,6 +185,7 @@ class ValidatorMonitor:
             s.block_seen_delay_sec = delay_sec
             if self._metrics:
                 self._metrics["proposed"].inc(index=str(proposer_index))
+                self._metrics["block_delay"].observe(delay_sec)
 
     def on_sync_committee_message(self, epoch: int, index: int) -> None:
         if index in self._monitored:

@@ -54,6 +54,7 @@ _PING = 1
 _PONG = 2
 _FINDNODE = 3
 _NODES = 4
+_PTYPE_NAMES = {_PING: "ping", _PONG: "pong", _FINDNODE: "findnode", _NODES: "nodes"}
 
 
 @dataclass
@@ -236,6 +237,9 @@ class Discovery(asyncio.DatagramProtocol):
         self._challenge_refill_t = time.monotonic()
         self._liveness_task: asyncio.Task | None = None
         self.on_discovered: list = []  # callbacks(enr)
+        # optional beacon metrics bundle (network wiring sets it); every
+        # increment is guarded so discovery runs identically unwired
+        self.metrics = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -298,6 +302,10 @@ class Discovery(asyncio.DatagramProtocol):
         packet = self.local_enr.node_id.encode() + sig + content
         if len(packet) <= MAX_PACKET:
             self.transport_udp.sendto(packet, addr)
+            if self.metrics is not None:
+                self.metrics.discv5_tx_total.inc(
+                    type=_PTYPE_NAMES.get(ptype, str(ptype))
+                )
 
     def datagram_received(self, data: bytes, addr) -> None:
         try:
@@ -318,6 +326,10 @@ class Discovery(asyncio.DatagramProtocol):
         # window costs nothing. (round-3 review)
         if (nonce >> 16) < (time.time() - _NONCE_WINDOW_SEC) * 1000:
             return
+        if self.metrics is not None:
+            self.metrics.discv5_rx_total.inc(
+                type=_PTYPE_NAMES.get(ptype, str(ptype))
+            )
         asyncio.get_running_loop().create_task(
             self._handle(node_id, sig, nonce, ptype, body, addr, content)
         )
@@ -396,6 +408,8 @@ class Discovery(asyncio.DatagramProtocol):
                         self._pending_findnode[node_id] = (tuple(addr)[:2], target)
                     return
                 if len(self._ping_addr) >= _MAX_CHALLENGES:
+                    if self.metrics is not None:
+                        self.metrics.discv5_challenge_drops_total.inc()
                     return  # full table of live challenges: shed load
                 self._challenge_tokens = min(
                     _CHALLENGE_PINGS_PER_SEC,
@@ -404,6 +418,8 @@ class Discovery(asyncio.DatagramProtocol):
                 )
                 self._challenge_refill_t = now
                 if self._challenge_tokens < 1.0:
+                    if self.metrics is not None:
+                        self.metrics.discv5_challenge_drops_total.inc()
                     return  # over the global challenge-PING budget
                 self._challenge_tokens -= 1.0
                 self._pending_findnode[node_id] = (tuple(addr)[:2], target)
@@ -483,6 +499,8 @@ class Discovery(asyncio.DatagramProtocol):
             return True
         except asyncio.TimeoutError:
             self.table.remove(enr.node_id)
+            if self.metrics is not None:
+                self.metrics.discv5_liveness_evictions_total.inc()
             return False
         finally:
             # a stale future must not swallow a later request's response
@@ -515,6 +533,8 @@ class Discovery(asyncio.DatagramProtocol):
         """Iterative Kademlia lookup: query ALPHA closest, absorb NODES
         (inserted by the receive path), repeat until the closest-known
         distance stops improving."""
+        if self.metrics is not None:
+            self.metrics.discv5_lookups_total.inc()
         queried: set[str] = set()
 
         def best() -> int:

@@ -26,6 +26,7 @@ from ...chain.validation import (
     validate_gossip_proposer_slashing,
     validate_gossip_voluntary_exit,
 )
+from ...observability import spans as _spans
 from ...utils.logger import get_logger
 from ...utils.queue import JobItemQueue, QueueType
 from .encoding import decode_message
@@ -90,24 +91,39 @@ class GossipHandlers:
         import asyncio
 
         topic, wire = item
-        try:
-            ssz = decode_message(wire)
-        except ValueError:
-            return ValidationResult.REJECT
-        from ...ssz import DeserializationError
+        # one lifecycle trace per gossip message: wire decode → validation
+        # ladder → (for blocks) bls verify → fork choice → import → head
+        # update, all correlated under one trace-id (observability.spans)
+        with _spans.tracer.trace(
+            f"gossip/{topic.type.value}", kind=topic.type.value
+        ):
+            with _spans.tracer.span("gossip/decode", wire_bytes=len(wire)):
+                try:
+                    ssz = decode_message(wire)
+                except ValueError:
+                    return ValidationResult.REJECT
+            from ...ssz import DeserializationError
 
-        try:
-            # run validation + import in an executor thread: the handler does
-            # BLS verification and may wait on the chain's import lock (held
-            # by range sync), neither of which may stall the event loop
-            return await asyncio.get_running_loop().run_in_executor(
-                None, self._handle, topic, ssz
-            )
-        except DeserializationError:
-            return ValidationResult.REJECT  # undecodable object = bad peer
-        except Exception as e:  # noqa: BLE001 — a handler bug must not REJECT
-            log.debug(f"handler error on {topic.type.value}: {e}")
-            return ValidationResult.IGNORE
+            # run_in_executor does not copy contextvars: hand the worker
+            # thread the live span explicitly so its spans stay correlated
+            trace_ctx = _spans.tracer.context()
+            try:
+                # run validation + import in an executor thread: the handler
+                # does BLS verification and may wait on the chain's import
+                # lock (held by range sync), neither of which may stall the
+                # event loop
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, self._handle_traced, trace_ctx, topic, ssz
+                )
+            except DeserializationError:
+                return ValidationResult.REJECT  # undecodable object = bad peer
+            except Exception as e:  # noqa: BLE001 — a handler bug must not REJECT
+                log.debug(f"handler error on {topic.type.value}: {e}")
+                return ValidationResult.IGNORE
+
+    def _handle_traced(self, trace_ctx, topic, ssz: bytes) -> ValidationResult:
+        with _spans.tracer.attach(trace_ctx):
+            return self._handle(topic, ssz)
 
     def _handle(self, topic, ssz: bytes) -> ValidationResult:
         chain, types = self.chain, self.types
@@ -115,10 +131,17 @@ class GossipHandlers:
 
         if t is GossipType.beacon_block:
             signed = types.SignedBeaconBlock.deserialize(ssz)
-            result = validate_gossip_block(chain, types, signed)
+            slot = int(signed.message.slot)
+            _spans.tracer.annotate(
+                slot=slot, root=signed.message.hash_tree_root().hex()
+            )
+            _milestone(chain, "block_received", slot)
+            with _spans.tracer.span("validation/block", slot=slot):
+                result = validate_gossip_block(chain, types, signed)
             if result.action is GossipAction.ACCEPT:
+                _milestone(chain, "validated", slot)
                 chain.seen_block_proposers.add(
-                    int(signed.message.slot), int(signed.message.proposer_index)
+                    slot, int(signed.message.proposer_index)
                 )
                 try:
                     chain.process_block(
@@ -132,14 +155,25 @@ class GossipHandlers:
 
         if t is GossipType.beacon_attestation:
             att = types.Attestation.deserialize(ssz)
-            result = validate_gossip_attestation(chain, types, att, topic.subnet)
+            with _spans.tracer.span(
+                "validation/attestation", slot=int(att.data.slot)
+            ):
+                result = validate_gossip_attestation(
+                    chain, types, att, topic.subnet
+                )
             if result.action is GossipAction.ACCEPT:
                 chain.on_gossip_attestation(att, result.data_root)
             return _ACTION_TO_RESULT[result.action]
 
         if t is GossipType.beacon_aggregate_and_proof:
             signed_agg = types.SignedAggregateAndProof.deserialize(ssz)
-            result = validate_gossip_aggregate_and_proof(chain, types, signed_agg)
+            with _spans.tracer.span(
+                "validation/aggregate",
+                slot=int(signed_agg.message.aggregate.data.slot),
+            ):
+                result = validate_gossip_aggregate_and_proof(
+                    chain, types, signed_agg
+                )
             if result.action is GossipAction.ACCEPT:
                 chain.on_aggregated_attestation(
                     signed_agg.message.aggregate, result.data_root
@@ -217,6 +251,17 @@ class GossipHandlers:
 
         # light-client updates: served, not consumed, by full nodes
         return ValidationResult.IGNORE
+
+
+def _milestone(chain, name: str, slot: int) -> None:
+    """Record a slot milestone via the chain (which owns the clock and the
+    metrics bundle); tolerant of stub chains in tests."""
+    rec = getattr(chain, "_record_milestone", None)
+    if rec is not None:
+        try:
+            rec(name, slot)
+        except Exception:
+            pass  # milestone telemetry must never fail the handler
 
 
 def _persist_invalid_ssz(obj, kind: str, error: Exception) -> None:

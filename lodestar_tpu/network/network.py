@@ -133,6 +133,7 @@ class Network:
         )
         attnets = self.attnets.enr_attnets(epoch)
         self.discovery = Discovery(self.transport.identity, enr)
+        self.discovery.metrics = self.metrics
         self.discovery.update_attnets(attnets)
         self.discovery.on_discovered.append(self._on_discovered)
         await self.discovery.start(bind_host or advertise_addr[0])
@@ -343,6 +344,8 @@ class Network:
         m.peers_connected.set(len(self.transport.connections))
         if self.discovery is not None:
             m.discovery_table_size.set(len(self.discovery.table))
+            m.discv5_endpoint_proofs.set(len(self.discovery._endpoint_proven))
+            m.discv5_pending_challenges.set(len(self.discovery._ping_addr))
         from .gossip.topic import parse_topic
 
         by_kind: dict[str, int] = {}

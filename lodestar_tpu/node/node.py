@@ -76,6 +76,24 @@ class BeaconNode:
 
         install_gc_metrics(self.metrics.registry)
 
+        # 2b. lifecycle tracing: the process-wide tracer backs the metrics
+        # server's /debug/traces; completed traces tick the prometheus
+        # counter. Re-init (tests, in-process restart) REPLACES the node
+        # hook so a dead registry stops receiving counts.
+        from ..observability import spans as _spans
+
+        self.tracer = _spans.tracer
+
+        def _count_trace(doc, _m=self.metrics):
+            kind = (doc.get("attrs") or {}).get("kind") or doc["name"]
+            _m.lifecycle_traces_total.inc(kind=kind)
+
+        _count_trace._node_wired = True
+        self.tracer.on_finish[:] = [
+            cb for cb in self.tracer.on_finish
+            if not getattr(cb, "_node_wired", False)
+        ] + [_count_trace]
+
         # 3. chain (verifier choice mirrors reference blsVerifyAllMainThread);
         # the device tier sits behind the cross-thread batching facade so
         # concurrent gossip-queue validations merge into device batches
@@ -137,7 +155,8 @@ class BeaconNode:
             self.log.info("REST API on :%d", self.api_server.port)
         if opts.metrics:
             self.metrics_server = MetricsServer(
-                self.metrics.registry, port=opts.metrics_port
+                self.metrics.registry, port=opts.metrics_port,
+                tracer=self.tracer,
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)

@@ -19,6 +19,11 @@ threaded through the verifier stack:
   graceful skip, atexit/SIGTERM JSON flush, so a benchmark run ALWAYS
   ends in one parseable JSON document (kills the `parsed: null`
   failure mode of BENCH_r05).
+- `spans` — node-wide lifecycle tracing (PR 2): trace-id/parent-id
+  spans with contextvar propagation threaded from gossip decode through
+  validation, BLS verify, fork choice and head update; ring-buffer
+  retention served by the metrics server's `/debug/traces`; slot-
+  milestone delay metrics.
 """
 
 from .stages import (  # noqa: F401
@@ -36,3 +41,10 @@ from .trace import (  # noqa: F401
     stop_profiling,
 )
 from .bench_emit import BenchEmitter, PhaseTimeout  # noqa: F401
+from .spans import (  # noqa: F401
+    MILESTONES,
+    Tracer,
+    current_trace_id,
+    record_slot_milestone,
+    tracer,
+)

@@ -10,8 +10,25 @@ from __future__ import annotations
 import logging
 import sys
 
-_FORMAT = "%(asctime)s %(levelname)-5s [%(name)s] %(message)s"
+_FORMAT = "%(asctime)s %(levelname)-5s [%(name)s]%(trace)s %(message)s"
 _configured = False
+
+
+class _TraceContextFilter(logging.Filter):
+    """Inject the active lifecycle trace-id (observability.spans) into
+    every record, so a slow-slot log line correlates with its
+    `/debug/traces` entry. Outside any trace the field renders empty."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tid = None
+        try:
+            from ..observability.spans import current_trace_id
+
+            tid = current_trace_id()
+        except Exception:
+            pass
+        record.trace = f" [t:{tid[:8]}]" if tid else ""
+        return True
 
 
 def _ensure_configured() -> None:
@@ -20,6 +37,7 @@ def _ensure_configured() -> None:
         return
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+    handler.addFilter(_TraceContextFilter())
     root = logging.getLogger("lodestar_tpu")
     root.addHandler(handler)
     root.setLevel(logging.INFO)

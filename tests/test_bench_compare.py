@@ -1,0 +1,120 @@
+"""tools/bench_compare.py: the CI benchmark regression gate (ISSUE 2;
+reference analog `.benchrc.yaml` 3x threshold) exercised on synthetic
+BENCH histories and on the repo's committed history."""
+
+import importlib.util
+import json
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    path = os.path.join(REPO_ROOT, "tools", "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _round(tmp_path, n, value, phases=None, parsed=True, extra=None):
+    doc = {"n": n, "rc": 0 if parsed else 124, "parsed": None}
+    if parsed:
+        doc["parsed"] = {
+            "metric": "bls_signature_sets_verified_per_sec",
+            "value": value,
+            "unit": "sets/s",
+        }
+        if phases:
+            doc["parsed"]["phases"] = phases
+        if extra:
+            doc["parsed"].update(extra)
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+
+def test_exits_nonzero_on_3x_regression(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    _round(tmp_path, 2, 2000.0)  # 4.5x drop
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "bls_signature_sets_verified_per_sec" in out
+
+
+def test_exits_zero_on_improvement_and_mild_drop(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 8000.0)
+    _round(tmp_path, 2, 9000.0)  # improvement
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    _round(tmp_path, 3, 4000.0)  # 2.25x drop: inside the 3x budget
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+    # a tighter gate catches the same drop
+    assert mod.main(["--dir", str(tmp_path), "--threshold", "1.5"]) == 1
+    capsys.readouterr()
+
+
+def test_unparseable_rounds_are_skipped(tmp_path, capsys):
+    """A timed-out round (parsed: null — the BENCH_r05 mode) carries no
+    rows; the gate compares the last two PARSEABLE rounds instead of
+    false-failing."""
+    mod = _load()
+    _round(tmp_path, 1, 8000.0)
+    _round(tmp_path, 2, 9000.0)
+    _round(tmp_path, 3, 0.0, parsed=False)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "r01 -> r02" in capsys.readouterr().out
+
+
+def test_phase_rows_and_time_keys_compare(tmp_path, capsys):
+    """New-format documents (bench_emit phases) flatten into gated rows;
+    latency keys regress on GROWTH, and timed-out phases are skipped."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0, phases={
+        "e2e": {"status": "ok", "rows": {"e2e_wire_to_verdict_sets_per_sec": 2000.0}},
+        "hasher": {"status": "ok", "rows": {"hasher_1m_one_change_ms": 12.0}},
+    })
+    _round(tmp_path, 2, 9000.0, phases={
+        "e2e": {"status": "ok", "rows": {"e2e_wire_to_verdict_sets_per_sec": 1900.0}},
+        "hasher": {"status": "ok", "rows": {"hasher_1m_one_change_ms": 50.0}},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 1  # 12 -> 50 ms: >3x slower
+    assert "hasher.hasher_1m_one_change_ms" in capsys.readouterr().out
+    # a timed-out phase in the latest round drops out of the comparison
+    _round(tmp_path, 3, 9000.0, phases={
+        "hasher": {"status": "timeout", "rows": {}},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+
+
+def test_insufficient_history_is_not_a_failure(tmp_path, capsys):
+    mod = _load()
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    _round(tmp_path, 1, 9000.0)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "nothing to gate" in capsys.readouterr().out
+
+
+def test_committed_bench_history_passes():
+    """The acceptance gate: the repo's own BENCH_r*.json history must be
+    green (r05 never parsed and is skipped; r03 -> r04 improved)."""
+    mod = _load()
+    assert mod.main(["--dir", REPO_ROOT]) == 0
+
+
+def test_details_file_augments_latest_round(tmp_path, capsys):
+    mod = _load()
+    # legacy flat rows (rounds <= 5 style) in the prior round
+    _round(tmp_path, 1, 9000.0,
+           extra={"e2e_wire_to_verdict_sets_per_sec": 2000.0})
+    _round(tmp_path, 2, 9000.0)
+    details = tmp_path / "bench_details.json"
+    # legacy flat details format: rows merge into the latest round
+    details.write_text(json.dumps({
+        "metric": "bls_signature_sets_verified_per_sec",
+        "value": 9000.0,
+        "e2e_wire_to_verdict_sets_per_sec": 500.0,  # 4x drop vs r01
+    }))
+    assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 1
+    assert "e2e_wire_to_verdict_sets_per_sec" in capsys.readouterr().out

@@ -242,39 +242,27 @@ def test_full_node_registry_breadth_and_format():
 def test_dashboards_reference_real_metrics():
     """Every panel expression in dashboards/*.json must reference a
     metric family that actually exists in the live registry (VERDICT r4
-    #8: dashboards backed by real metrics, enforced)."""
+    #8: dashboards backed by real metrics, enforced). Delegates token
+    extraction to tools/check_dashboards (the single copy of the PromQL
+    parsing rules) so this test cannot drift from the lint."""
     import glob
-    import json
+    import importlib.util
     import os
-    import re
 
-    from lodestar_tpu.metrics.validator_monitor import ValidatorMonitor
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "check_dashboards.py"
+    )
+    spec = importlib.util.spec_from_file_location("check_dashboards_tm", path)
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
 
-    m = create_beacon_metrics()
-    ValidatorMonitor(m.registry)  # registers the validator_monitor_* families
-    known = {metric.name for metric in m.registry._metrics}
-    # histograms expose _bucket/_sum/_count series
-    for metric in m.registry._metrics:
-        if metric.kind == "histogram":
-            known |= {metric.name + s for s in ("_bucket", "_sum", "_count")}
-
+    known, _families = lint.registry_names()
     dash_dir = os.path.join(os.path.dirname(__file__), "..", "dashboards")
     files = sorted(glob.glob(os.path.join(dash_dir, "*.json")))
-    assert len(files) >= 10  # 5 from rounds 1-4 + 5 new
-    unknown = []
-    for path in files:
-        doc = json.load(open(path))
-        for panel in doc["panels"]:
-            for target in panel["targets"]:
-                for name in re.findall(
-                    r"[a-z][a-z0-9_]{3,}", target["expr"]
-                ):
-                    if name in (
-                        "rate", "histogram_quantile", "sum", "irate", "avg"
-                    ):
-                        continue
-                    if name not in known:
-                        unknown.append(
-                            (os.path.basename(path), panel["title"], name)
-                        )
+    assert len(files) >= 16  # reference parity (ISSUE 2)
+    unknown = [
+        (fname, title, name)
+        for fname, title, name in lint.dashboard_refs(dash_dir)
+        if name not in known
+    ]
     assert not unknown, f"dashboard panels with unknown metrics: {unknown}"

@@ -1,0 +1,314 @@
+"""Lifecycle tracing (ISSUE 2): span nesting across asyncio tasks and
+executor threads, ring-buffer retention, disabled-mode zero overhead,
+slot-milestone emission on a stubbed block import, /debug/traces
+retrieval, trace-id log correlation, and the _verify_now **kwargs
+facade regression (ADVICE round 5).
+
+Kernels are stubbed (MockBlsVerifier) — the span layer is pure host
+bookkeeping and must be testable without a device.
+"""
+
+import asyncio
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.observability import spans
+from lodestar_tpu.observability.spans import Tracer
+
+
+# --- core span mechanics -----------------------------------------------------
+
+
+def test_span_nesting_and_parentage():
+    t = Tracer(capacity=8)
+    with t.trace("root", kind="test"):
+        with t.span("child"):
+            with t.span("grandchild"):
+                pass
+        with t.span("sibling"):
+            pass
+    docs = t.traces()
+    assert len(docs) == 1
+    by_name = {s["name"]: s for s in docs[0]["spans"]}
+    assert set(by_name) == {"root", "child", "grandchild", "sibling"}
+    root_id = by_name["root"]["span_id"]
+    assert by_name["child"]["parent_id"] == root_id
+    assert by_name["sibling"]["parent_id"] == root_id
+    assert by_name["grandchild"]["parent_id"] == by_name["child"]["span_id"]
+    assert by_name["root"]["parent_id"] is None
+    # every span carries the root's trace id implicitly: one doc, one id
+    assert docs[0]["trace_id"]
+
+
+def test_span_nesting_across_asyncio_tasks():
+    """Tasks created inside a span copy the context at creation time, so
+    concurrent children correlate under the same trace root."""
+    t = Tracer(capacity=8)
+
+    async def main():
+        with t.trace("root"):
+            async def child(i):
+                with t.span(f"task{i}"):
+                    await asyncio.sleep(0.01)
+
+            await asyncio.gather(child(0), child(1), child(2))
+
+    asyncio.run(main())
+    (doc,) = t.traces()
+    names = {s["name"] for s in doc["spans"]}
+    assert names == {"root", "task0", "task1", "task2"}
+    root_id = next(s["span_id"] for s in doc["spans"] if s["name"] == "root")
+    for s in doc["spans"]:
+        if s["name"] != "root":
+            assert s["parent_id"] == root_id
+
+
+def test_cross_thread_context_attach():
+    """Executor threads don't inherit contextvars; context()/attach()
+    is the explicit handoff the gossip handler uses."""
+    t = Tracer(capacity=8)
+    seen = {}
+    with t.trace("root"):
+        ctx = t.context()
+
+        def work():
+            # without attach: no active span in this thread
+            seen["before"] = t.current_trace_id()
+            with t.attach(ctx), t.span("worker"):
+                seen["inside"] = t.current_trace_id()
+
+        th = threading.Thread(target=work)
+        th.start()
+        th.join()
+    (doc,) = t.traces()
+    assert seen["before"] is None
+    assert seen["inside"] == doc["trace_id"]
+    assert {s["name"] for s in doc["spans"]} == {"root", "worker"}
+
+
+def test_ring_buffer_eviction_keeps_newest():
+    t = Tracer(capacity=4)
+    for i in range(10):
+        with t.trace(f"t{i}"):
+            pass
+    docs = t.traces(limit=100)
+    assert len(docs) == 4
+    assert [d["name"] for d in docs] == ["t9", "t8", "t7", "t6"]
+    assert t.completed_total == 10
+
+
+def test_disabled_mode_zero_overhead():
+    t = Tracer(enabled=False)
+    # one shared null singleton: no allocation per call
+    assert t.span("a") is t.span("b") is t.trace("c")
+    with t.trace("x"):
+        with t.span("y"):
+            pass
+    assert t.traces() == []
+    assert t.context() is None
+    assert t.current_trace_id() is None
+    with t.attach(None):
+        pass  # no-op, no error
+    # annotate/event on the null span are no-ops too
+    t.span("z").annotate(slot=1).event("e")
+
+
+def test_error_status_and_filtering():
+    t = Tracer(capacity=8)
+    with pytest.raises(RuntimeError):
+        with t.trace("bad", slot=3):
+            raise RuntimeError("boom")
+    with t.trace("good"):
+        t.annotate(slot=4, root="ab" * 16)
+    assert t.traces(slot=3)[0]["spans"][0]["status"] == "error"
+    assert "boom" in t.traces(slot=3)[0]["spans"][0]["attrs"]["error"]
+    assert t.traces(slot=4)[0]["name"] == "good"
+    assert t.traces(root="0x" + "ab" * 16)[0]["name"] == "good"
+    assert t.traces(slot=99) == []
+
+
+def test_child_attrs_promote_to_trace_root():
+    """slot/root learned mid-trace (after decode) must make the whole
+    trace filterable."""
+    t = Tracer(capacity=8)
+    with t.trace("gossip/beacon_block", kind="beacon_block"):
+        with t.span("validation/block", slot=11):
+            pass
+    (doc,) = t.traces(slot=11)
+    assert doc["slot"] == 11 and doc["attrs"]["kind"] == "beacon_block"
+
+
+def test_on_finish_callbacks_fire():
+    t = Tracer(capacity=8)
+    kinds = []
+    t.on_finish.append(lambda doc: kinds.append(doc["name"]))
+    with t.trace("a"):
+        pass
+    assert kinds == ["a"]
+
+
+# --- logger correlation ------------------------------------------------------
+
+
+def test_logger_injects_trace_id():
+    from lodestar_tpu.utils.logger import _TraceContextFilter
+
+    f = _TraceContextFilter()
+    rec = logging.LogRecord("n", logging.INFO, "p", 1, "msg", (), None)
+    f.filter(rec)
+    assert rec.trace == ""  # outside any trace
+    with spans.tracer.trace("log-test"):
+        tid = spans.current_trace_id()
+        rec2 = logging.LogRecord("n", logging.INFO, "p", 1, "msg", (), None)
+        f.filter(rec2)
+        assert rec2.trace == f" [t:{tid[:8]}]"
+
+
+# --- the acceptance path: stubbed block import -> one correlated trace -------
+
+
+@pytest.fixture(scope="module")
+def traced_chain():
+    from lodestar_tpu.chain import BeaconChain
+    from lodestar_tpu.chain.bls_verifier import MockBlsVerifier
+    from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.metrics import create_beacon_metrics
+    from lodestar_tpu.params.presets import MINIMAL
+    from lodestar_tpu.state_transition import interop_genesis_state
+    from lodestar_tpu.types import get_types
+
+    types = get_types(MINIMAL).phase0
+    fork_config = ChainForkConfig(MINIMAL_CHAIN_CONFIG, MINIMAL)
+    state = interop_genesis_state(
+        fork_config, types, 16, genesis_time=1_600_000_000
+    )
+    config = BeaconConfig(
+        MINIMAL_CHAIN_CONFIG, bytes(state.genesis_validators_root), MINIMAL
+    )
+    chain = BeaconChain(config, types, state, verifier=MockBlsVerifier())
+    chain.metrics = create_beacon_metrics()
+    chain.clock.set_slot(1)
+    return config, types, chain
+
+
+def test_stubbed_block_import_produces_correlated_trace(traced_chain):
+    """ISSUE 2 acceptance: one gossip-driven block import = one trace
+    with >= 5 spans (decode, validation, bls-verify, fork-choice,
+    import) retrievable from /debug/traces, plus the five slot-milestone
+    delay series on /metrics."""
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+    from lodestar_tpu.network.gossip.encoding import encode_message
+    from lodestar_tpu.network.gossip.gossipsub import ValidationResult
+    from lodestar_tpu.network.gossip.handlers import GossipHandlers
+    from lodestar_tpu.network.gossip.topic import GossipTopic, GossipType
+
+    config, types, chain = traced_chain
+    block = chain.produce_block(1, randao_reveal=b"\x00" * 96)
+    signed = types.SignedBeaconBlock(message=block, signature=b"\x11" * 96)
+    wire = encode_message(signed.serialize())
+    topic = GossipTopic(GossipType.beacon_block, b"\x01\x02\x03\x04")
+
+    spans.tracer.clear()
+    handlers = GossipHandlers(config, types, chain)
+    result = asyncio.run(handlers._process((topic, wire)))
+    assert result is ValidationResult.ACCEPT
+
+    docs = spans.tracer.traces(slot=1)
+    assert docs, "gossip import produced no trace"
+    doc = docs[0]
+    names = [s["name"] for s in doc["spans"]]
+    for required in (
+        "gossip/decode",
+        "validation/block",
+        "chain/bls_verify",
+        "chain/fork_choice",
+        "chain/import",
+    ):
+        assert required in names, f"{required} missing from {names}"
+    assert len(doc["spans"]) >= 5
+    assert doc["root"] == block.hash_tree_root().hex()
+    # filterable by root as served over HTTP
+    srv = MetricsServer(MetricsRegistry(), port=0, tracer=spans.tracer)
+    srv.start()
+    try:
+        url = (
+            f"http://127.0.0.1:{srv.port}/debug/traces"
+            f"?root=0x{doc['root']}&limit=5"
+        )
+        with urllib.request.urlopen(url) as r:
+            served = json.load(r)
+        assert served["count"] >= 1
+        assert served["traces"][0]["trace_id"] == doc["trace_id"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/traces?slot=999999"
+        ) as r:
+            assert json.load(r)["count"] == 0
+    finally:
+        srv.close()
+
+    # slot-milestone delays render on /metrics, one series per milestone
+    text = chain.metrics.registry.expose()
+    for milestone in spans.MILESTONES:
+        assert (
+            f'lodestar_slot_milestone_last_delay_seconds{{milestone="{milestone}"}}'
+            in text
+        ), milestone
+    assert 'lodestar_slot_milestone_delay_seconds_bucket' in text
+    # milestones are also trace events, timestamped within the trace
+    events = [e["name"] for s in doc["spans"] for e in s.get("events", [])]
+    for milestone in spans.MILESTONES:
+        assert milestone in events
+
+
+def test_milestones_skipped_for_historic_blocks(traced_chain):
+    """Range-sync imports of old blocks must not pollute the milestone
+    histograms with hours-old 'delays'."""
+    config, types, chain = traced_chain
+    before = chain.metrics.slot_milestone_seconds._totals.copy()
+    chain._record_milestone("imported", chain.clock.current_slot - 5)
+    assert chain.metrics.slot_milestone_seconds._totals == before
+    chain._record_milestone("imported", chain.clock.current_slot)
+    key = ("imported",)
+    assert chain.metrics.slot_milestone_seconds._totals[key] == \
+        before.get(key, 0) + 1
+
+
+# --- _verify_now facade detection (ADVICE round 5) ---------------------------
+
+
+def test_verify_now_uses_batchable_false_through_kwargs_facade():
+    """A wrapper that only exposes **kwargs must still receive
+    batchable=False on the latency-critical import path."""
+    from lodestar_tpu.chain.chain import _verify_now
+
+    calls = []
+
+    class KwargsFacade:
+        def verify_signature_sets(self, sets, **kwargs):
+            calls.append(kwargs)
+            return True
+
+    assert _verify_now(KwargsFacade(), [object()]) is True
+    assert calls == [{"batchable": False}]
+
+    class ExplicitFacade:
+        def verify_signature_sets(self, sets, batchable=True):
+            calls.append({"batchable": batchable})
+            return True
+
+    assert _verify_now(ExplicitFacade(), [object()]) is True
+    assert calls[-1] == {"batchable": False}
+
+    class BareFacade:
+        def verify_signature_sets(self, sets):
+            calls.append("bare")
+            return True
+
+    assert _verify_now(BareFacade(), [object()]) is True
+    assert calls[-1] == "bare"

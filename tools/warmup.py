@@ -14,10 +14,16 @@ makes warm-up an explicit, documented step:
 
 The production ladder = every shape the buffered verifier can dispatch
 steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
-(16x8, 64x64) + the bench shapes when --bench is given. Reference analog:
-the reference avoids this class of problem by having no compile step at
-all (blst is AOT); on TPU the restart story is "run warmup.py once per
-binary/kernel revision" (docs/architecture.md §compile-cache).
+(16x8, 64x64) + the pk-grouped config (128x32 — the adversarial
+unique-root flood defense routes here) + the bench shapes when --bench
+is given. With --device-decompress (or LODESTAR_TPU_DEVICE_DECOMPRESS=1)
+the *_raw kernel variants — on-chip signature decode + subgroup checks —
+are compiled for the same shapes, so a node running the
+device-decompress path never pays their cold compile at runtime
+(ADVICE round 5). Reference analog: the reference avoids this class of
+problem by having no compile step at all (blst is AOT); on TPU the
+restart story is "run warmup.py once per binary/kernel revision"
+(docs/architecture.md §compile-cache).
 """
 
 from __future__ import annotations
@@ -63,22 +69,34 @@ def prune_cache(limit_gb: float) -> None:
     print(f"pruned {removed} entries -> {total / (1 << 30):.2f} GiB")
 
 
-def warm_production(include_bench: bool) -> None:
+def warm_production(include_bench: bool, device_decompress: bool = False) -> None:
     """Compile the production dispatch ladder on the current platform
     (TPU when available — run this at deploy; each shape is one cached
-    XLA executable)."""
+    XLA executable). `device_decompress` adds the *_raw kernel variants
+    (on-chip signature decode) for every shape in the ladder."""
     import jax
 
     jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
 
-    from __graft_entry__ import _example_arrays, _example_grouped
+    from __graft_entry__ import (
+        _example_arrays,
+        _example_grouped,
+        _example_pk_grouped,
+    )
     from lodestar_tpu.parallel.verifier import BatchVerifier, SetArrays, _rand_pairs
 
     buckets = (4, 16, 64, 128) + ((4096,) if include_bench else ())
     grouped = ((16, 8), (64, 64)) + (
         ((64, 256), (64, 512)) if include_bench else ()
     )
-    bv = BatchVerifier(buckets=buckets, grouped_configs=grouped)
+    # the pk-grouped dual-axis config: the planner's default
+    # (parallel/verifier pk_grouped_configs) — an adversarial unique-root
+    # flood routes production batches here, so a cold compile at that
+    # moment is exactly the missed-slots failure this tool prevents
+    pk_grouped = ((128, 32),)
+    bv = BatchVerifier(
+        buckets=buckets, grouped_configs=grouped, pk_grouped_configs=pk_grouped
+    )
     for b in buckets:
         arrs = SetArrays(b)
         (arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
@@ -93,11 +111,35 @@ def warm_production(include_bench: bool) -> None:
         jax.block_until_ready(ok)
         print(f"individual bucket {b}: {time.monotonic() - t0:.1f}s", flush=True)
     for rows, lanes in grouped:
-        g, a_bits, b_bits = _example_grouped(rows, lanes)
+        if device_decompress:
+            g, a_bits, b_bits, sig_raw = _example_grouped(rows, lanes, raw=True)
+        else:
+            g, a_bits, b_bits = _example_grouped(rows, lanes)
         t0 = time.monotonic()
         ok = bool(bv.verify_grouped(g, a_bits, b_bits))
         print(f"grouped {rows}x{lanes}: {time.monotonic() - t0:.1f}s "
               f"verdict={ok}", flush=True)
+        if device_decompress:
+            t0 = time.monotonic()
+            ok = bool(bv.verify_grouped_raw(g, sig_raw, a_bits, b_bits))
+            print(f"grouped raw {rows}x{lanes}: {time.monotonic() - t0:.1f}s "
+                  f"verdict={ok}", flush=True)
+    for rows, lanes in pk_grouped:
+        if device_decompress:
+            g, a_bits, b_bits, sig_raw = _example_pk_grouped(
+                rows, lanes, raw=True
+            )
+        else:
+            g, a_bits, b_bits = _example_pk_grouped(rows, lanes)
+        t0 = time.monotonic()
+        ok = bool(bv.verify_pk_grouped(g, a_bits, b_bits))
+        print(f"pk-grouped {rows}x{lanes}: {time.monotonic() - t0:.1f}s "
+              f"verdict={ok}", flush=True)
+        if device_decompress:
+            t0 = time.monotonic()
+            ok = bool(bv.verify_pk_grouped_raw(g, sig_raw, a_bits, b_bits))
+            print(f"pk-grouped raw {rows}x{lanes}: "
+                  f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
 
 
 def warm_dryrun(n: int) -> None:
@@ -119,6 +161,10 @@ def main() -> None:
                     help="mesh size for --dryrun")
     ap.add_argument("--bench", action="store_true",
                     help="also warm the bench shapes (4096-set, 64x256/512)")
+    ap.add_argument("--device-decompress", action="store_true",
+                    help="also warm the *_raw kernels (on-chip signature "
+                         "decode; default when LODESTAR_TPU_DEVICE_DECOMPRESS"
+                         " is set)")
     ap.add_argument("--prune-gb", type=float, default=None,
                     help="GC the cache to this many GiB (LRU) and exit")
     args = ap.parse_args()
@@ -128,7 +174,10 @@ def main() -> None:
     if args.dryrun:
         warm_dryrun(args.devices)
         return
-    warm_production(args.bench)
+    device_decompress = args.device_decompress or os.environ.get(
+        "LODESTAR_TPU_DEVICE_DECOMPRESS", ""
+    ).lower() in ("1", "true", "on")
+    warm_production(args.bench, device_decompress=device_decompress)
 
 
 if __name__ == "__main__":
