@@ -82,6 +82,14 @@ class CpuBlsVerifier:
 class DeviceBlsVerifier:
     """Device-tier verifier over the XLA batch kernels.
 
+    Device-side signature decompression is the DEFAULT wire→verdict path
+    (LODESTAR_TPU_DEVICE_DECOMPRESS=0 is the off-switch); batches the
+    native tier can't marshal (odd signature/message lengths, missing C
+    extension) silently fall back to the host-marshal path — that
+    downgrade is logged (rate-limited) and counted
+    (`lodestar_bls_verifier_decompress_fallback_total`) so a default-path
+    e2e regression is visible instead of silent.
+
     Every dispatch runs inside a named `TraceAnnotation` scope (the
     SURVEY §5 tracing hook at the verifier boundary; stages inside the
     fused kernel carry `jax.named_scope` tags — view with
@@ -90,6 +98,8 @@ class DeviceBlsVerifier:
     `start_profiling()` here, or the metrics server's `/profiler/start`
     endpoint — all share one process-wide switch
     (`observability.trace`)."""
+
+    _FALLBACK_LOG_INTERVAL_S = 60.0
 
     def __init__(
         self,
@@ -107,6 +117,7 @@ class DeviceBlsVerifier:
         self.observer = self._inner.observer
         self.max_sets_per_job = buckets[-1]
         self._profile_dir = os.environ.get("LODESTAR_TPU_PROFILE")
+        self._last_fallback_log = float("-inf")
 
     def _annotate(self, label: str):
         from ..observability import trace
@@ -128,10 +139,35 @@ class DeviceBlsVerifier:
     def h2c_cache_size(self) -> int:
         return len(self._inner._h2c_cache)
 
+    def _note_decompress_fallback(self, sets) -> None:
+        """Count + rate-limited-log a device-decompress batch downgraded
+        to host marshal because `_native_eligible` rejected its shape —
+        the default e2e path quietly losing its ~6x win must be visible
+        (round-6 satellite; VERDICT r5 #4)."""
+        if not sets or not self._inner._device_decompress:
+            return
+        if self._inner._native_eligible(sets):
+            return
+        self.observer.decompress_fallback()
+        now = time.monotonic()
+        if now - self._last_fallback_log >= self._FALLBACK_LOG_INTERVAL_S:
+            self._last_fallback_log = now
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").warning(
+                "device-decompress batch (%d sets) fell back to host "
+                "marshal: native tier ineligible (non-standard "
+                "message/signature lengths or missing C extension); "
+                "further downgrades counted in "
+                "lodestar_bls_verifier_decompress_fallback_total",
+                len(sets),
+            )
+
     def verify_signature_sets(self, sets) -> bool:
         sets = list(sets)
         if not sets:
             return False
+        self._note_decompress_fallback(sets)
         # chunk oversized batches (reference chunkifyMaximizeChunkSize)
         with self._annotate(f"bls_verify_batch/{len(sets)}"):
             for i in range(0, len(sets), self.max_sets_per_job):
@@ -143,6 +179,7 @@ class DeviceBlsVerifier:
 
     def verify_signature_sets_individual(self, sets) -> list[bool]:
         sets = list(sets)
+        self._note_decompress_fallback(sets)
         out: list[bool] = []
         with self._annotate(f"bls_verify_individual/{len(sets)}"):
             for i in range(0, len(sets), self.max_sets_per_job):

@@ -385,6 +385,7 @@ class BeaconChain:
         m = getattr(self, "metrics", None)
         pending = []
         all_sets: list = []
+        set_slots: list[int] = []  # signing block's slot, parallel to all_sets
         state = None
         finalized_slot = st_util.compute_start_slot_at_epoch(
             self.fork_choice.store.finalized_checkpoint[0],
@@ -410,9 +411,9 @@ class BeaconChain:
                 if block.slot > pre.state.slot:
                     process_slots(pre, self.types, block.slot)
             if verify_signatures:
-                all_sets.extend(
-                    get_block_signature_sets(pre, self.types, signed)
-                )
+                block_sets = get_block_signature_sets(pre, self.types, signed)
+                all_sets.extend(block_sets)
+                set_slots.extend([int(block.slot)] * len(block_sets))
             # payload verification overlaps the NEXT block's STF (the
             # per-block path's 3-way overlap, segment-shaped)
             fut_payload = self._verify_pool.submit(
@@ -440,7 +441,37 @@ class BeaconChain:
                 if not batch_ok:
                     if m is not None:
                         m.block_import_errors_total.inc(reason="signature")
-                    raise BlockImportError("segment signature batch failed")
+                    # bisection verdicts make pinpointing cheap (O(k·log N)
+                    # final exps on the device tier), so name the offending
+                    # block instead of failing the whole segment opaquely —
+                    # the caller's re-download/peer-scoring can act on it
+                    detail = ""
+                    pinpoint = getattr(
+                        self.bls, "verify_signature_sets_individual", None
+                    )
+                    if callable(pinpoint):
+                        try:
+                            with _spans.tracer.span(
+                                "chain/bls_pinpoint", sets=len(all_sets)
+                            ):
+                                verdicts = pinpoint(all_sets)
+                            bad_slots = sorted(
+                                {
+                                    set_slots[i]
+                                    for i, ok in enumerate(verdicts)
+                                    if not ok
+                                }
+                            )
+                            if bad_slots:
+                                detail = (
+                                    f" (invalid signature in block(s) at "
+                                    f"slot(s) {bad_slots})"
+                                )
+                        except Exception:
+                            pass  # pinpointing is best-effort diagnostics
+                    raise BlockImportError(
+                        "segment signature batch failed" + detail
+                    )
                 if pending:
                     self._record_milestone(
                         "sigs_verified", pending[-1][0].message.slot

@@ -98,6 +98,13 @@ class BeaconNode:
         # the device tier sits behind the cross-thread batching facade so
         # concurrent gossip-queue validations merge into device batches
         if opts.tpu_verifier:
+            # persistent XLA compile cache BEFORE the first kernel trace:
+            # a node restart must hit `tools/warmup.py`'s cached
+            # executables, not recompile the dispatch ladder cold
+            # (LODESTAR_TPU_COMPILE_CACHE overrides/disables)
+            from ..utils.jax_env import enable_compile_cache
+
+            enable_compile_cache()
             from ..chain.bls_verifier import (
                 DeviceBlsVerifier,
                 ThreadBufferedVerifier,

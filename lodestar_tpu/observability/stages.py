@@ -8,6 +8,9 @@ host stages (timed inline, monotonic clock):
     rand           random-coefficient bit planes
     dispatch       host->XLA submit time (async; excludes device compute)
     device_wait    resolver block time (`block_until_ready`-bounded)
+    bisect         bisection probe dispatches on a failed verdict tree
+                   (batched shared-easy-part final exps; device tag
+                   `bls/bisect` inside the probe kernel)
 
 device stages (attributable two ways: `trace.named_scope` tags inside the
 fused kernel for XLA profiles, and `stage_profile.profile_stages` timing
@@ -34,6 +37,7 @@ STAGES = (
     "rand",
     "dispatch",
     "device_wait",
+    "bisect",
     "g2_decompress",
     "scalar_mul",
     "msm_planes",
@@ -145,6 +149,31 @@ class PipelineMetrics:
             "lodestar_bls_verifier_device_busy_fraction",
             "fraction of wall time the device spent on verify dispatches",
         )
+        # bisection verdicts (round-6 tentpole): per-batch outcome plus
+        # round/probe totals — an all-valid batch is one `clean` tick
+        # with zero rounds (the ≤1-final-exp fast path); k invalid sets
+        # tick `bisected` with O(log N) rounds and O(k·log N) probes
+        self.bisect_batches = r.counter(
+            "lodestar_bls_verifier_bisect_batches_total",
+            "per-set verdict batches by outcome (clean = root passed)",
+            label_names=("outcome",),
+        )
+        self.bisect_rounds_total = r.counter(
+            "lodestar_bls_verifier_bisect_rounds_total",
+            "bisection rounds walked on failed per-set verdict batches",
+        )
+        self.bisect_probes_total = r.counter(
+            "lodestar_bls_verifier_bisect_probes_total",
+            "product-tree nodes probed (batched final exps) during bisection",
+        )
+        # device-decompress downgrade visibility (round-6 satellite): the
+        # default path silently falling back to host marshal would
+        # otherwise be an invisible e2e regression
+        self.decompress_fallbacks = r.counter(
+            "lodestar_bls_verifier_decompress_fallback_total",
+            "device-decompress batches downgraded to host marshal "
+            "(native tier ineligible for the batch shape)",
+        )
         # device-busy sampler state: busy seconds accumulate per resolve,
         # the fraction is re-sampled over >=1 s wall windows
         self._busy_lock = threading.Lock()
@@ -171,6 +200,19 @@ class PipelineMetrics:
     def cache_event(self, cache: str, hit: bool, n: int = 1) -> None:
         if n:
             self.cache_events.inc(n, cache=cache, outcome="hit" if hit else "miss")
+
+    def bisect(self, rounds: int, probes: int) -> None:
+        """Record one per-set verdict batch's bisection outcome."""
+        self.bisect_batches.inc(
+            outcome="clean" if rounds == 0 else "bisected"
+        )
+        if rounds:
+            self.bisect_rounds_total.inc(rounds)
+        if probes:
+            self.bisect_probes_total.inc(probes)
+
+    def decompress_fallback(self, n: int = 1) -> None:
+        self.decompress_fallbacks.inc(n)
 
     # -- queue / flush ------------------------------------------------------
 
@@ -222,6 +264,21 @@ class PipelineMetrics:
             for labels, v in self.cache_events.collect()
         }
         return {"decisions": decisions, "sets": sets, "cache_events": caches}
+
+    def bisect_snapshot(self) -> dict:
+        """Bisection-verdict counters for the bench document: batch
+        outcomes, total rounds walked, total nodes probed, and the
+        decompress→host-marshal downgrade count."""
+        outcomes = {
+            labels.get("outcome", ""): int(v)
+            for labels, v in self.bisect_batches.collect()
+        }
+        return {
+            "batches": outcomes,
+            "rounds": int(self.bisect_rounds_total.value()),
+            "probes": int(self.bisect_probes_total.value()),
+            "decompress_fallbacks": int(self.decompress_fallbacks.value()),
+        }
 
 
 def create_pipeline_metrics(registry: MetricsRegistry) -> PipelineMetrics:

@@ -252,25 +252,38 @@ def wrap(cols) -> Sum:
 def reduce_stack(sums: "list[Sum]") -> "list[jnp.ndarray]":
     """Canonical [0, 2p) limbs for every Sum, ONE shared carry scan.
 
-    Each expression is biased by a shared multiple of 2p (≡ 0 mod p, so
+    Each expression is biased by its own multiple of 2p (≡ 0 mod p, so
     values are unchanged mod p) to make it non-negative, then reduced by
-    selecting among k candidates v − i·2p in a single stacked scan —
-    the i-th candidate's final borrow says whether i·2p still fits."""
+    selecting among k_j candidates v − i·2p in a single stacked scan —
+    the i-th candidate's final borrow says whether i·2p still fits.
+
+    Candidate counts are PER SUM (ADVICE r5): sizing every expression to
+    the loosest bounds in the stack padded the scan with dead lanes —
+    e.g. cyclotomic_square's c0 spans 14 candidates but rode its
+    neighbor's 23. The scan now carries Σ k_j rows instead of
+    len(sums)·max k_j; selection logic per Sum is unchanged."""
     shape = jnp.broadcast_shapes(*(s.cols.shape for s in sums))
-    bias = max(0, -min(s.lo for s in sums))
-    hi = max(s.hi for s in sums) + bias
-    k = max(1, math.ceil(hi))  # value < k·2p after biasing
-    base = jnp.stack(
-        [jnp.broadcast_to(s.cols + bias * _TWO_P, shape) for s in sums]
-    )
-    cands = jnp.stack([base - i * _TWO_P for i in range(k)])
-    limbs, out = _carry_scan_out(cands)
-    # largest non-negative candidate via a fused where-chain (a gather
-    # here measurably slowed the latency-bound kernels)
-    res = limbs[0]
-    for i in range(1, k):
-        res = jnp.where((out[i] >= 0)[..., None], limbs[i], res)
-    return [res[i] for i in range(len(sums))]
+    cands = []
+    spans: list[tuple[int, int]] = []  # (first candidate row, k_j) per Sum
+    for s in sums:
+        bias = max(0, -math.floor(s.lo))
+        k = max(1, math.ceil(s.hi + bias))  # value < k·2p after biasing
+        base = jnp.broadcast_to(s.cols + bias * _TWO_P, shape)
+        spans.append((len(cands), k))
+        for i in range(k):
+            cands.append(base - i * _TWO_P)
+    limbs, out = _carry_scan_out(jnp.stack(cands))
+    results = []
+    for start, k in spans:
+        # largest non-negative candidate via a fused where-chain (a gather
+        # here measurably slowed the latency-bound kernels)
+        res = limbs[start]
+        for i in range(1, k):
+            res = jnp.where(
+                (out[start + i] >= 0)[..., None], limbs[start + i], res
+            )
+        results.append(res)
+    return results
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

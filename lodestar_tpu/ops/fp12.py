@@ -142,6 +142,33 @@ def inv(a):
     return _join(out[0], fp6.neg(out[1]))
 
 
+def batch_inv(a):
+    """Element-wise inverse over axis 0 via Montgomery's product trick:
+    ONE tower inversion (a ~570-sequential-multiply Fermat chain) plus
+    log-depth prefix/suffix product scans replaces n independent
+    inversion chains —
+
+        a_i⁻¹ = (Π_{j<i} a_j) · (Π_{j>i} a_j) · (Π_j a_j)⁻¹.
+
+    The amortized entry behind `pairing.final_exponentiation_batch`
+    (bisection probes share the easy part's inversion). All inputs must
+    be nonzero — a single zero lane poisons the whole batch (the callers
+    feed Miller-loop outputs and identity padding, never zero)."""
+    n = a.shape[0]
+    if n == 1:
+        return inv(a)
+    from jax import lax
+
+    inc = lax.associative_scan(mul, a, axis=0)  # inclusive prefix products
+    inc_rev = lax.associative_scan(mul, jnp.flip(a, axis=0), axis=0)
+    # exclusive prefix (identity-shifted) and exclusive suffix
+    ident = one((1,) + a.shape[1:-4])
+    pre = jnp.concatenate([ident, inc[:-1]], axis=0)
+    suf = jnp.concatenate([jnp.flip(inc_rev, axis=0)[1:], ident], axis=0)
+    total_inv = inv(inc[-1:])  # unit batch axis: see pairing's axon note
+    return mul(mul(pre, suf), total_inv)
+
+
 def mul_by_line(f, l0, l1, l2):
     """f · (l0 + l1·w² + l2·w³), l_i ∈ Fp2 — the sparse pairing-line update.
 

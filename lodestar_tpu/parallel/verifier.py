@@ -47,6 +47,7 @@ from ..ops.g2_decompress import decompress as _g2_decompress, planes_in_subgroup
 from ..ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
 from ..ops.pairing import (
     final_exponentiation,
+    final_exponentiation_batch,
     miller_loop,
     miller_loop_proj_pq,
     miller_loop_projective,
@@ -66,6 +67,7 @@ from ..ops.points import (
 N_LIMBS = 32
 R_BITS = 64  # random-coefficient width (matches blst's 64-bit rand scaling)
 HALF_BITS = 32  # the a/b halves of the r = a + z·b GLS split
+PROBE_LANES = 16  # bisection probe batch width: ONE compiled shape, chunked
 
 __all__ = [
     "BatchVerifier",
@@ -75,10 +77,16 @@ __all__ = [
     "PkGroupedArrays",
     "grouped_verify_kernel",
     "pk_grouped_verify_kernel",
+    "bisect_tree_kernel",
+    "bisect_probe_kernel",
 ]
 
 
 _fp12_product_tree = fp12.product_tree
+
+# host-side Fp12 identity for bisection probe padding (lazy: building it
+# touches the device, which import-time code must not)
+_FP12_ONE_NP = None
 
 
 def _g2_sum_tree(ps):
@@ -444,6 +452,71 @@ def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
         return fp12.is_one(final_exponentiation(prod)) & valid
 
 
+def bisect_tree_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
+    """Per-set randomized Fp12 terms + EVERY product-tree level, one
+    final exponentiation — the bisection-verdict fast path.
+
+    The per-set verdict path used to pay N final exps per batch
+    (`individual_verify_kernel`). The classic batch-verification-with-
+    bisection result does better: each lane contributes an independent
+    randomized term
+
+        f_i = ML(r_i·pk_i, H_i) · ML(−g1, r_i·sig_i)
+
+    whose final exp is ε_i^{r_i} (ε_i = the set's pairing error). The
+    product tree over f_i is materialized LEVEL BY LEVEL: the root passes
+    exactly when every set is valid (up to the 2^-64 random-combination
+    soundness — blst's own bound), which costs ONE final exp for the
+    common all-valid case. On failure the host binary-searches the
+    already-materialized internal nodes (`TpuBlsVerifier._bisect`): k
+    invalid sets cost O(k·log N) probe final exps instead of N, and each
+    leaf probe is EXACT (r_i < 2^64 < r is invertible mod r, so
+    ε_i^{r_i} = 1 ⟺ ε_i = 1) — leaf verdicts match
+    `individual_verify_kernel` bit-for-bit.
+
+    Returns (root_ok, levels): levels[0] (M,) leaf terms with M = N
+    padded to a power of two (identity padding), levels[j] (M >> j,)
+    partial products, levels[-1] (1,) the root. Padding lanes (valid
+    False) contribute the identity and must be reported False by the
+    caller."""
+    n = pk_x.shape[0]
+    with named_scope("bls/scalar_mul"):
+        rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+        rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
+    neg_gy = fp.neg(G1_GEN_Y)
+    px = jnp.concatenate([rpk[0], jnp.broadcast_to(G1_GEN_X, (n, N_LIMBS))], 0)
+    py = jnp.concatenate([rpk[1], jnp.broadcast_to(neg_gy, (n, N_LIMBS))], 0)
+    pz = jnp.concatenate([rpk[2], fp.one((n,))], 0)
+    qx = jnp.concatenate([msg_x, rsig[0]], 0)
+    qy = jnp.concatenate([msg_y, rsig[1]], 0)
+    qz = jnp.concatenate([fp2.one((n,)), rsig[2]], 0)
+    with named_scope("bls/miller_loop"):
+        fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    f = fp12.mul(fs[:n], fs[n:])
+    f = fp12.select(valid, f, fp12.one((n,)))
+    m = 1 << max(0, (n - 1).bit_length())
+    if m > n:
+        f = jnp.concatenate([f, fp12.one((m - n,))], 0)
+    with named_scope("bls/product_tree"):
+        levels = [f]
+        while f.shape[0] > 1:
+            f = fp12.mul(f[0::2], f[1::2])
+            levels.append(f)
+    with named_scope("bls/final_exp"):
+        root_ok = fp12.is_one(final_exponentiation(levels[-1][0]))
+    return root_ok, levels
+
+
+def bisect_probe_kernel(fs):
+    """(PROBE_LANES,) stacked product-tree nodes → (PROBE_LANES,) bool:
+    is_one(final_exp) per lane, the easy part's inversion shared across
+    the whole probe batch (`final_exponentiation_batch` — Montgomery
+    product trick). Identity-padded lanes pass trivially and are sliced
+    off by the host."""
+    with named_scope("bls/bisect"):
+        return fp12.is_one(final_exponentiation_batch(fs))
+
+
 class SetArrays:
     """Host-marshalled signature sets, padded to a fixed lane count."""
 
@@ -599,6 +672,8 @@ class BatchVerifier:
         self._grouped_raw = jax.jit(grouped_verify_kernel_raw)
         self._pk_grouped = jax.jit(pk_grouped_verify_kernel)
         self._pk_grouped_raw = jax.jit(pk_grouped_verify_kernel_raw)
+        self._bisect_tree = jax.jit(bisect_tree_kernel)
+        self._bisect_probe = jax.jit(bisect_probe_kernel)
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -651,6 +726,20 @@ class BatchVerifier:
             arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
             arrs.sig_x, arrs.sig_y, arrs.valid,
         )
+
+    def verify_bisect_tree(self, arrs: SetArrays, r_bits: np.ndarray):
+        """(root_ok, product-tree levels) for the bisection-verdict path;
+        the all-valid common case is decided by root_ok alone (ONE final
+        exp), levels feed `TpuBlsVerifier._bisect` on failure."""
+        return self._bisect_tree(
+            arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+            arrs.sig_x, arrs.sig_y, r_bits, arrs.valid,
+        )
+
+    def probe_nodes(self, fs: np.ndarray):
+        """(PROBE_LANES,) stacked Fp12 tree nodes → (PROBE_LANES,) bool
+        via one batched shared-easy-part final exp."""
+        return self._bisect_probe(fs)
 
 
 class TpuBlsVerifier:
@@ -712,13 +801,21 @@ class TpuBlsVerifier:
         # On-device signature decompression + batched plane subgroup
         # checks (ops/g2_decompress): removes the ~0.6 ms/set C-tier
         # signature marshal — the e2e floor on few-core hosts (VERDICT
-        # r4 #5). Costs two Fp pow chains per lane on device; hosts with
-        # cores to spare can keep the C tier. Constructor arg wins, then
-        # LODESTAR_TPU_DEVICE_DECOMPRESS=1.
+        # r4 #5). DEFAULT-ON since round 6 (VERDICT r5 #4: the round's
+        # biggest e2e win shipped off by default): the differential
+        # coverage (tests/test_ops_decompress.py, the raw-kernel twins in
+        # tests/test_parallel_verifier.py) is the same evidence the limb
+        # kernels rest on. Constructor arg wins, then
+        # LODESTAR_TPU_DEVICE_DECOMPRESS=0 as the off-switch (hosts with
+        # cores to spare can keep the C tier); batches the native tier
+        # can't marshal fall back to the host path automatically
+        # (`_native_eligible` gates every raw dispatch).
         if device_decompress is None:
             device_decompress = (
-                __import__("os").environ.get("LODESTAR_TPU_DEVICE_DECOMPRESS")
-                == "1"
+                __import__("os").environ.get(
+                    "LODESTAR_TPU_DEVICE_DECOMPRESS", "1"
+                ).lower()
+                not in ("0", "off", "false")
             )
         self._device_decompress = bool(device_decompress)
 
@@ -1243,19 +1340,95 @@ class TpuBlsVerifier:
         return lambda: all(self._resolve(r, t_submit) for r in results)
 
     def verify_signature_sets_individual(self, sets) -> list[bool]:
+        """Per-set verdicts via BISECTION (round-6 tentpole): one
+        randomized product-tree dispatch decides the all-valid common
+        case with a single final exponentiation; on failure the
+        materialized internal nodes are binary-searched so k invalid
+        sets cost O(k·log N) batched probe final exps instead of N
+        (`individual_verify_kernel`'s price). Leaf probes are exact, so
+        verdicts match the old kernel (and the CPU oracle) bit-for-bit;
+        internal short-circuits carry the same 2^-64 soundness as batch
+        verification itself."""
         self.observer.planner("individual", len(sets))
         with self.observer.stage("marshal"):
             arrs = self._marshal(sets)
         if arrs is None:
             # mirror reference behavior: individually report malformed as False
             return [self._verify_one(s) for s in sets]
+        with self.observer.stage("rand"):
+            r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
         t = time.monotonic()
         with self.observer.stage("dispatch"):
-            result = self.kernels.verify_individual(arrs)
+            root_ok, levels = self.kernels.verify_bisect_tree(arrs, r_bits)
         with self.observer.stage("device_wait"):
-            out = np.asarray(result)
+            root_ok = bool(root_ok)
         self.observer.device_busy_sample(time.monotonic() - t)
-        return [bool(v) for v in out[: arrs.n]]
+        if root_ok:
+            self.observer.bisect(rounds=0, probes=0)
+            return [True] * arrs.n
+        verdicts = self._bisect(arrs, levels)
+        return [bool(v) for v in verdicts[: arrs.n]]
+
+    def _bisect(self, arrs, levels) -> np.ndarray:
+        """Binary-search a failed product tree for the invalid leaves.
+
+        levels[j] holds M >> j nodes; node (j, i) covers leaves
+        [i·2^j, (i+1)·2^j). BFS from the root: every round probes the
+        children of the currently-failed nodes — all probes of a round
+        ride ONE fixed-shape batched final exp (PROBE_LANES lanes,
+        identity-padded, shared easy-part inversion), so a round is one
+        dispatch until k grows past PROBE_LANES/2. A child that passes
+        clears its whole subtree (2^-64 soundness per probe); failed
+        level-0 nodes are the invalid sets, exactly.
+
+        Freak outcome — a failed parent with two passing children (a
+        2^-64 cancellation): fall back to the exact per-set kernel
+        rather than return an inconsistent verdict vector."""
+        levels_np = [np.asarray(l) for l in levels]
+        m = levels_np[0].shape[0]
+        verdicts = np.ones(m, bool)
+        verdicts[arrs.n:] = False  # padding lanes report False
+        frontier = [(len(levels_np) - 1, 0)]
+        rounds = probes = 0
+        global _FP12_ONE_NP
+        if _FP12_ONE_NP is None:
+            _FP12_ONE_NP = np.asarray(fp12.one(()))
+        while frontier:
+            if frontier[0][0] == 0:
+                for _, i in frontier:
+                    verdicts[i] = False
+                break
+            rounds += 1
+            children = [
+                (lvl - 1, 2 * i + k) for lvl, i in frontier for k in (0, 1)
+            ]
+            failed = []
+            for lo in range(0, len(children), PROBE_LANES):
+                chunk = children[lo : lo + PROBE_LANES]
+                batch = np.stack([levels_np[l][i] for l, i in chunk])
+                if len(chunk) < PROBE_LANES:
+                    pad = np.broadcast_to(
+                        _FP12_ONE_NP,
+                        (PROBE_LANES - len(chunk),) + _FP12_ONE_NP.shape,
+                    )
+                    batch = np.concatenate([batch, pad])
+                t0 = time.monotonic()
+                with self.observer.stage("bisect"):
+                    out = np.asarray(self.kernels.probe_nodes(batch))
+                self.observer.device_busy_sample(time.monotonic() - t0)
+                probes += len(chunk)
+                failed.extend(
+                    node for node, ok in zip(chunk, out[: len(chunk)])
+                    if not ok
+                )
+            if not failed:
+                # 2^-64 cancellation inside a subtree: exact fallback
+                self.observer.bisect(rounds=rounds, probes=probes)
+                out = np.asarray(self.kernels.verify_individual(arrs))
+                return out
+            frontier = failed
+        self.observer.bisect(rounds=rounds, probes=probes)
+        return verdicts
 
     def _verify_one(self, s) -> bool:
         try:

@@ -80,8 +80,11 @@ def test_phase_rows_and_time_keys_compare(tmp_path, capsys):
     })
     assert mod.main(["--dir", str(tmp_path)]) == 1  # 12 -> 50 ms: >3x slower
     assert "hasher.hasher_1m_one_change_ms" in capsys.readouterr().out
-    # a timed-out phase in the latest round drops out of the comparison
+    # a timed-out NON-required phase in the latest round drops out of the
+    # comparison (the REQUIRED e2e row must still be present — it's gated
+    # by name; see test_required_key_missing_fails)
     _round(tmp_path, 3, 9000.0, phases={
+        "e2e": {"status": "ok", "rows": {"e2e_wire_to_verdict_sets_per_sec": 1850.0}},
         "hasher": {"status": "timeout", "rows": {}},
     })
     assert mod.main(["--dir", str(tmp_path)]) == 0
@@ -118,3 +121,50 @@ def test_details_file_augments_latest_round(tmp_path, capsys):
     }))
     assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 1
     assert "e2e_wire_to_verdict_sets_per_sec" in capsys.readouterr().out
+
+
+# --- required gated keys (round 6) -------------------------------------------
+
+
+def test_required_key_gated_across_phase_rename(tmp_path, capsys):
+    """The per-set floor moving from a legacy flat key into a phase row
+    must STAY gated: base-name matching catches a >3x drop that exact-key
+    intersection would silently skip."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0,
+           extra={"device_sets_per_sec_floor_distinct_pk_and_msg": 3200.0})
+    _round(tmp_path, 2, 9000.0, phases={
+        "worst_case": {"status": "ok", "rows": {
+            "device_sets_per_sec_floor_distinct_pk_and_msg": 800.0,  # 4x drop
+        }},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "device_sets_per_sec_floor_distinct_pk_and_msg" in out
+
+
+def test_required_key_missing_fails(tmp_path, capsys):
+    """A required row present in the prior round but absent from the
+    current one fails the gate — a disappeared row hides regressions as
+    effectively as a slow one (the BENCH_r05 lesson)."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0,
+           extra={"e2e_wire_to_verdict_sets_per_sec": 2000.0})
+    _round(tmp_path, 2, 9500.0)  # e2e row gone
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "missing from current round" in capsys.readouterr().out
+
+
+def test_required_key_improvement_passes(tmp_path, capsys):
+    """The round-6 re-bind (e2e_wire_to_verdict now the device-decompress
+    default path, ~6x faster) is an IMPROVEMENT and must pass."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0,
+           extra={"e2e_wire_to_verdict_sets_per_sec": 2042.0})
+    _round(tmp_path, 2, 9000.0, phases={
+        "e2e": {"status": "ok", "rows": {
+            "e2e_wire_to_verdict_sets_per_sec": 12039.0,
+        }},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    capsys.readouterr()

@@ -64,6 +64,21 @@ def _stub_kernels(verifier, verdict=True):
     k.verify_individual = lambda arrs, *a, **kw: np.full(
         arrs.valid.shape, verdict
     )
+    # bisection-verdict seam: an all-`verdict` tree whose root reports
+    # `verdict` and whose levels let the host bisect when False
+    def bisect_tree(arrs, r_bits):
+        m = 1 << max(0, (arrs.valid.shape[0] - 1).bit_length())
+        levels = []
+        n = m
+        while n >= 1:
+            levels.append(np.zeros((n, 2, 3, 2, 32), np.int32))
+            if n == 1:
+                break
+            n //= 2
+        return np.bool_(verdict), levels
+
+    k.verify_bisect_tree = bisect_tree
+    k.probe_nodes = lambda fs: np.full((fs.shape[0],), verdict)
 
 
 # --- stage timers / planner counters -----------------------------------------
@@ -118,6 +133,59 @@ def test_planner_counters_per_set_and_individual_paths():
     out = v.verify_signature_sets_individual(sets)
     assert out == [True, True, True]
     assert p.planner_decisions.value(path="individual") == 1
+    # the all-valid bisection fast path: one clean batch, zero rounds
+    snap = p.bisect_snapshot()
+    assert snap["batches"] == {"clean": 1}
+    assert snap["rounds"] == 0 and snap["probes"] == 0
+
+
+@needs_native
+def test_bisect_counters_on_failed_root(monkeypatch):
+    """A failed tree root walks the host bisection driver: rounds and
+    probes tick, failed leaves surface as False (kernels stubbed — the
+    probe reports every node failed, so every set comes back invalid)."""
+    from lodestar_tpu.parallel.verifier import TpuBlsVerifier
+
+    p = PipelineMetrics()
+    v = TpuBlsVerifier(observer=p)
+    _stub_kernels(v, verdict=False)
+    sets = _sets(4, shared_root=False)
+    out = v.verify_signature_sets_individual(sets)
+    assert out == [False] * 4
+    snap = p.bisect_snapshot()
+    assert snap["batches"] == {"bisected": 1}
+    assert snap["rounds"] == 2  # log2(4) levels below the root
+    assert snap["probes"] > 0
+    assert p.stage_seconds._totals.get(("bisect",), 0) >= 1
+
+
+@needs_native
+def test_decompress_fallback_logged_and_counted():
+    """A device-decompress batch the native tier can't marshal (65-byte
+    message) must tick the fallback counter — the default-path downgrade
+    is visible, not silent (round-6 satellite)."""
+    from lodestar_tpu.chain.bls_verifier import DeviceBlsVerifier
+
+    m = create_beacon_metrics()
+    dev = DeviceBlsVerifier(observer=m.pipeline)
+    _stub_kernels(dev._inner)
+    assert dev._inner._device_decompress  # default-on since round 6
+    sk = bls.interop_secret_key(1)
+    odd_msg = b"\x55" * 65  # not a 32-byte root: native tier ineligible
+    sets = [
+        bls.SignatureSet(
+            pubkey=sk.to_public_key(),
+            message=odd_msg,
+            signature=sk.sign(odd_msg).to_bytes(),
+        )
+    ]
+    assert dev.verify_signature_sets(sets)
+    assert m.pipeline.decompress_fallbacks.value() == 1
+    # native-eligible batches do NOT tick the counter
+    assert dev.verify_signature_sets(_sets(3))
+    assert m.pipeline.decompress_fallbacks.value() == 1
+    text = m.registry.expose()
+    assert "lodestar_bls_verifier_decompress_fallback_total 1" in text
 
 
 # --- the acceptance path: ThreadBufferedVerifier -> /metrics -----------------

@@ -221,3 +221,56 @@ def test_lazy_fp2_with_nonreduced_representatives():
         assert s0 < 2 * P and s1 < 2 * P
         assert s0 % P == (a0 * a0 - a1 * a1) * r_inv % P
         assert s1 % P == 2 * a0 * a1 * r_inv % P
+
+
+def test_reduce_stack_per_sum_candidate_counts():
+    """reduce_stack sizes its candidate scan PER SUM (round 6): a tight
+    expression next to a loose one must still reduce correctly, and the
+    total candidate count must be Σ k_j, not len(sums)·max k_j."""
+    import numpy as np
+
+    from lodestar_tpu.bls.fields import P
+    from lodestar_tpu.ops.limbs import int_to_limbs, limbs_to_int
+
+    rng2 = random.Random(77)
+    for _ in range(5):
+        a = rng2.randrange(2 * P)
+        b = rng2.randrange(2 * P)
+        c = rng2.randrange(2 * P)
+        av = jnp.asarray(int_to_limbs(a))[None]
+        bv = jnp.asarray(int_to_limbs(b))[None]
+        cv = jnp.asarray(int_to_limbs(c))[None]
+        W = fp.wrap
+        # tight Sum (value < 4p, k=2) stacked with a loose one (8c − a,
+        # lo = −1, hi = 8 → bias 1, k = 9) and a subtraction that goes
+        # negative (needs its own bias, not the neighbor's)
+        tight = W(av) + W(bv)
+        loose = W(cv).double().double().double() - W(av)
+        negy = W(av) - W(bv) - W(cv)
+        outs = fp.reduce_stack([tight, loose, negy])
+        got = [limbs_to_int(np.asarray(o)[0]) for o in outs]
+        for g, expect in zip(
+            got, [(a + b) % P, (8 * c - a) % P, (a - b - c) % P]
+        ):
+            assert g < 2 * P and g % P == expect
+    # candidate accounting: the shared scan must carry Σ k_j rows — the
+    # tight Sum's 2 + the loose one's 9 + the negative one's 4 — not
+    # 3 sums × the loosest k (ADVICE r5: c0 rode its neighbor's k)
+    seen = {}
+    orig = fp._carry_scan_out
+
+    def spy(t):
+        seen["rows"] = t.shape[0]
+        return orig(t)
+
+    fp._carry_scan_out = spy
+    try:
+        W = fp.wrap
+        fp.reduce_stack([
+            W(av) + W(bv),                                  # hi 2 → k=2
+            W(cv).double().double().double() - W(av),       # [-1, 8) → k=9
+            W(av) - W(bv) - W(cv),                          # [-2, 1) → k=3
+        ])
+    finally:
+        fp._carry_scan_out = orig
+    assert seen["rows"] == 2 + 9 + 3

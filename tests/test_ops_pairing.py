@@ -128,3 +128,27 @@ def test_pairing_check_masked_lane_is_identity():
     qy = np.stack([h_aff[1], sig_aff[1], garbage_q[1]])
     mask = np.array([True, True, False])
     assert bool(_check2_jit((xs, ys), (qx, qy), mask))
+
+
+def test_final_exponentiation_batch_bit_identical():
+    """The shared-easy-part batched final exp (fp12.batch_inv Montgomery
+    product trick — the bisection probe kernel's entry) must equal the
+    per-lane final_exponentiation bit-for-bit, including identity lanes
+    (the probe padding)."""
+    from lodestar_tpu.ops import fp12
+
+    ms = []
+    for _ in range(3):
+        p, q = _rand_g1(), _rand_g2()
+        ms.append(fq12_to_limbs(op.miller_loop(p, q)))
+    ms.append(np.asarray(fp12.one(())))  # identity padding lane
+    fs = np.stack(ms)
+    per_lane = np.asarray(fp.canonical(_finalexp_jit(fs)))
+    batched = np.asarray(
+        fp.canonical(jax.jit(dp.final_exponentiation_batch)(fs))
+    )
+    assert np.array_equal(per_lane, batched)
+    # identity lane passes is_one through the batch entry
+    assert bool(
+        np.asarray(fp12.is_one(jax.jit(dp.final_exponentiation_batch)(fs)))[-1]
+    )

@@ -28,7 +28,9 @@ def _det_rng():
 
 @pytest.fixture(scope="module")
 def verifier():
-    return TpuBlsVerifier(buckets=(4, 8), rng=_det_rng)
+    # device_decompress=False: these tests pin the HOST-MARSHAL path
+    # (default-on since round 6 — the raw-path twins live below)
+    return TpuBlsVerifier(buckets=(4, 8), rng=_det_rng, device_decompress=False)
 
 
 def _make_sets(n, salt=0):
@@ -126,7 +128,8 @@ def _make_shared_root_sets(n, n_roots, salt=0):
 @pytest.fixture(scope="module")
 def grouped_verifier():
     return TpuBlsVerifier(
-        buckets=(4, 16), rng=_det_rng, grouped_configs=((4, 4),)
+        buckets=(4, 16), rng=_det_rng, grouped_configs=((4, 4),),
+        device_decompress=False,
     )
 
 
@@ -347,6 +350,7 @@ def pk_verifier():
     return TpuBlsVerifier(
         buckets=(4, 16), grouped_configs=((4, 4),),
         pk_grouped_configs=((4, 4),), rng=_det_rng,
+        device_decompress=False,
     )
 
 
@@ -422,3 +426,130 @@ def test_pk_grouped_differential_vs_oracle(pk_verifier):
     )
     assert bls.verify_signature_sets(sets) is False
     assert pk_verifier.verify_signature_sets(sets) is False
+
+
+# --- bisection verdicts (round-6 tentpole) -----------------------------------
+#
+# The per-set verdict path now runs one randomized product-tree dispatch
+# (root pass = all valid, ONE final exp) and binary-searches the
+# materialized internal nodes on failure. Oracle-twin coverage: 0 / 1 /
+# k / all-invalid mixes vs CpuBlsVerifier, invalid sets planted at
+# padding-lane boundaries, and a property check that bisection verdicts
+# equal the individual_verify_kernel verdicts on random batches.
+
+
+@pytest.fixture(scope="module")
+def bisect_observer():
+    from lodestar_tpu.observability.stages import PipelineMetrics
+
+    return PipelineMetrics()
+
+
+@pytest.fixture(scope="module")
+def bisect_verifier(bisect_observer):
+    return TpuBlsVerifier(
+        buckets=(4, 8), rng=_det_rng, device_decompress=False,
+        observer=bisect_observer,
+    )
+
+
+def _oracle_verdicts(sets):
+    from lodestar_tpu.chain.bls_verifier import CpuBlsVerifier
+
+    return CpuBlsVerifier().verify_signature_sets_individual(sets)
+
+
+def _tamper(sets, idx, key=991):
+    wrong = bls.interop_secret_key(key)
+    sets = list(sets)
+    sets[idx] = bls.SignatureSet(
+        pubkey=sets[idx].pubkey,
+        message=sets[idx].message,
+        signature=wrong.sign(sets[idx].message).to_bytes(),
+    )
+    return sets
+
+
+def test_bisect_all_valid_zero_rounds(bisect_verifier, bisect_observer):
+    base = bisect_observer.bisect_snapshot()
+    sets = _make_sets(4, salt=300)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == _oracle_verdicts(sets) == [True] * 4
+    snap = bisect_observer.bisect_snapshot()
+    # the all-valid common case never bisects: ONE final exp, 0 rounds
+    assert snap["batches"].get("clean", 0) == base["batches"].get("clean", 0) + 1
+    assert snap["rounds"] == base["rounds"]
+
+
+def test_bisect_one_invalid_logn_rounds(bisect_verifier, bisect_observer):
+    base = bisect_observer.bisect_snapshot()
+    sets = _tamper(_make_sets(4, salt=310), 2)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == _oracle_verdicts(sets) == [True, True, False, True]
+    snap = bisect_observer.bisect_snapshot()
+    assert snap["batches"].get("bisected", 0) == base["batches"].get("bisected", 0) + 1
+    # one offender in a 4-leaf tree: exactly log2(4) = 2 rounds
+    assert snap["rounds"] - base["rounds"] == 2
+    assert snap["probes"] - base["probes"] > 0
+
+
+def test_bisect_k_invalid_mix(bisect_verifier):
+    sets = _tamper(_tamper(_make_sets(8, salt=320), 1), 6)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    expect = [i not in (1, 6) for i in range(8)]
+    assert out == expect == _oracle_verdicts(sets)
+
+
+def test_bisect_all_invalid(bisect_verifier):
+    sets = _make_sets(4, salt=330)
+    for i in range(4):
+        sets = _tamper(sets, i, key=900 + i)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == [False] * 4 == _oracle_verdicts(sets)
+
+
+def test_bisect_invalid_at_padding_boundary(bisect_verifier):
+    """5 sets in the 8-lane bucket: the last REAL lane (index 4) borders
+    three identity padding lanes — its subtree shares nodes with padding,
+    the exact place an indexing bug would flip a verdict."""
+    sets = _tamper(_make_sets(5, salt=340), 4)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == [True] * 4 + [False] == _oracle_verdicts(sets)
+    # first real lane for symmetry
+    sets = _tamper(_make_sets(5, salt=350), 0)
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == [False] + [True] * 4 == _oracle_verdicts(sets)
+
+
+def test_bisect_matches_individual_kernel_on_random_batches(bisect_verifier):
+    """Property check: bisection verdicts == individual_verify_kernel
+    verdicts on random valid/invalid mixes (the old kernel stays as the
+    exact fallback and the differential anchor)."""
+    import random
+
+    r = random.Random(61)
+    for trial in range(3):
+        sets = _make_sets(8, salt=400 + 10 * trial)
+        bad = sorted(r.sample(range(8), r.randint(0, 3)))
+        for i in bad:
+            sets = _tamper(sets, i, key=700 + i)
+        out = bisect_verifier.verify_signature_sets_individual(sets)
+        arrs = bisect_verifier._marshal(sets)
+        kernel_out = [
+            bool(v)
+            for v in np.asarray(
+                bisect_verifier.kernels.verify_individual(arrs)
+            )[: arrs.n]
+        ]
+        assert out == kernel_out, f"trial {trial}: bad={bad}"
+
+
+def test_bisect_malformed_set_uses_host_fallback(bisect_verifier):
+    """A set the marshaller rejects (malformed signature encoding) must
+    surface as False through the per-set host fallback, like before."""
+    sets = _make_sets(3, salt=360)
+    sets[1] = bls.SignatureSet(
+        pubkey=sets[1].pubkey, message=sets[1].message, signature=b"\x00" * 96
+    )
+    out = bisect_verifier.verify_signature_sets_individual(sets)
+    assert out == [True, False, True]

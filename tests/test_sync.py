@@ -164,9 +164,13 @@ def test_segment_import_batches_signatures_once():
 
     from lodestar_tpu.chain.chain import BlockImportError
 
-    with _pytest.raises(BlockImportError):
+    with _pytest.raises(BlockImportError) as ei:
         importer2.process_block_segment(bad_segment, verify_signatures=True)
     assert importer2.head_state.state.slot == 0
+    # round 6: the failure names the offending block (per-set verdicts —
+    # bisection on the device tier — pinpoint it instead of an opaque
+    # whole-segment failure); the tampered block sits at slot 4
+    assert "slot" in str(ei.value) and "4" in str(ei.value)
 
 
 def test_range_sync_download_import_overlap(two_nodes):

@@ -20,10 +20,17 @@ Comparison rules:
   threshold; time-shaped keys (`*_s`, `*_ms`, `*_seconds`) regress when
   they GROW by more than threshold; other keys (counts, fractions,
   configs) are informational only;
+- REQUIRED keys (`REQUIRED_GATED_KEYS`: the per-set floor and the
+  no-flags e2e rate — round-6 acceptance rows) are matched by BASE name
+  across phase-prefix renames, so moving a row between phases can't
+  silently drop it out of the gate; a required key present in the prior
+  round but MISSING from the current one fails the run (a disappeared
+  row hides regressions as effectively as a slow one);
 - fewer than two parseable rounds exits 0 with a note (nothing to gate
   against), never a false red.
 
-Exit code: 0 = no regression, 1 = at least one gated key regressed.
+Exit code: 0 = no regression, 1 = at least one gated key regressed (or a
+required key disappeared).
 """
 
 from __future__ import annotations
@@ -36,6 +43,13 @@ import re
 import sys
 
 DEFAULT_THRESHOLD = 3.0
+# rows the gate must never lose track of, matched by base name (the part
+# after any `phase.` prefix): the unconditional per-set floor and the
+# default-configuration wire-to-verdict rate
+REQUIRED_GATED_KEYS = (
+    "device_sets_per_sec_floor_distinct_pk_and_msg",
+    "e2e_wire_to_verdict_sets_per_sec",
+)
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 )
@@ -109,9 +123,25 @@ def _direction(key: str) -> str | None:
     return None
 
 
+def _find_by_base(rows: dict, base: str):
+    """(key, value) whose base name (after any `phase.` prefix) matches,
+    or None. Exact-name match wins over a prefixed one."""
+    if base in rows:
+        return base, rows[base]
+    for key, value in rows.items():
+        if key.rsplit(".", 1)[-1] == base:
+            return key, value
+    return None
+
+
 def compare(prev: dict, curr: dict, threshold: float) -> tuple[list, list]:
-    """(report_rows, regressions) between two rounds' row dicts."""
+    """(report_rows, regressions) between two rounds' row dicts.
+
+    Beyond the exact-key intersection, every REQUIRED_GATED_KEYS entry is
+    resolved by base name on both sides so phase renames can't drop it;
+    required keys present before but absent now count as regressions."""
     report, regressions = [], []
+    compared = set()
     for key in sorted(set(prev["rows"]) & set(curr["rows"])):
         direction = _direction(key)
         if direction is None:
@@ -122,8 +152,30 @@ def compare(prev: dict, curr: dict, threshold: float) -> tuple[list, list]:
         ratio = (p / c) if direction == "up" else (c / p)
         regressed = ratio > threshold
         report.append((key, direction, p, c, ratio, regressed))
+        compared.add(key.rsplit(".", 1)[-1])
         if regressed:
             regressions.append(key)
+    for base in REQUIRED_GATED_KEYS:
+        if base in compared:
+            continue
+        prev_hit = _find_by_base(prev["rows"], base)
+        curr_hit = _find_by_base(curr["rows"], base)
+        if prev_hit is None:
+            continue  # no history for this row yet — nothing to gate
+        if curr_hit is None:
+            # the row vanished: treat as a failed gate, not a silent skip
+            report.append((base, "up", prev_hit[1], 0.0, float("inf"), True))
+            regressions.append(f"{base} (missing from current round)")
+            continue
+        direction = _direction(base) or "up"
+        p, c = prev_hit[1], curr_hit[1]
+        if p <= 0 or c <= 0:
+            continue
+        ratio = (p / c) if direction == "up" else (c / p)
+        regressed = ratio > threshold
+        report.append((base, direction, p, c, ratio, regressed))
+        if regressed:
+            regressions.append(base)
     return report, regressions
 
 

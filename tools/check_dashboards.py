@@ -31,6 +31,19 @@ PROMQL_WORDS = {
     "without", "group_left", "group_right", "clamp_max", "clamp_min",
 }
 
+# panels specific subsystem dashboards must plot (ISSUE 3: the round-6
+# bisection-verdict and decompress-fallback families must be visible on
+# the bls-verifier dashboard, not just registered) — {file: metric
+# families at least one panel must reference}
+REQUIRED_PANEL_METRICS = {
+    "lodestar_tpu_bls_verifier.json": (
+        "lodestar_bls_verifier_bisect_batches_total",
+        "lodestar_bls_verifier_bisect_rounds_total",
+        "lodestar_bls_verifier_bisect_probes_total",
+        "lodestar_bls_verifier_decompress_fallback_total",
+    ),
+}
+
 # 16/16 parity with the reference dashboard set (ISSUE 2): one file per
 # reference dashboard, mapped to this repo's subsystem names
 REQUIRED_DASHBOARDS = (
@@ -120,6 +133,7 @@ def main(argv=None) -> int:
 
     missing = []
     referenced_families: set[str] = set()
+    per_file_refs: dict[str, set[str]] = {}
     for fname, title, name in dashboard_refs(dash_dir):
         if name in known:
             for suffix in ("_bucket", "_sum", "_count"):
@@ -127,11 +141,21 @@ def main(argv=None) -> int:
                     name = name[: -len(suffix)]
                     break
             referenced_families.add(name)
+            per_file_refs.setdefault(fname, set()).add(name)
         else:
             missing.append((fname, title, name))
 
     for fname, title, name in missing:
         print(f"MISSING {name}  ({fname} :: {title})")
+
+    unplotted_required = []
+    for fname, metric_names in REQUIRED_PANEL_METRICS.items():
+        refs = per_file_refs.get(fname, set())
+        for name in metric_names:
+            if name not in refs:
+                unplotted_required.append((fname, name))
+    for fname, name in unplotted_required:
+        print(f"NO-PANEL {name}  (required on {fname})")
     unexported = sorted(families - referenced_families)
     if unexported:
         print(
@@ -140,7 +164,7 @@ def main(argv=None) -> int:
         )
         for name in unexported:
             print(f"  unplotted {name}")
-    if missing or absent:
+    if missing or absent or unplotted_required:
         if missing:
             print(
                 f"FAIL: {len(missing)} dashboard references missing from "
@@ -150,6 +174,11 @@ def main(argv=None) -> int:
             print(
                 f"FAIL: {len(absent)}/{len(REQUIRED_DASHBOARDS)} required "
                 "dashboards absent"
+            )
+        if unplotted_required:
+            print(
+                f"FAIL: {len(unplotted_required)} required panel metric(s) "
+                "not plotted by their dashboard"
             )
         return 1
     print(

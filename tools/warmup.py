@@ -15,15 +15,19 @@ makes warm-up an explicit, documented step:
 The production ladder = every shape the buffered verifier can dispatch
 steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
 (16x8, 64x64) + the pk-grouped config (128x32 — the adversarial
-unique-root flood defense routes here) + the bench shapes when --bench
-is given. With --device-decompress (or LODESTAR_TPU_DEVICE_DECOMPRESS=1)
-the *_raw kernel variants — on-chip signature decode + subgroup checks —
-are compiled for the same shapes, so a node running the
-device-decompress path never pays their cold compile at runtime
-(ADVICE round 5). Reference analog: the reference avoids this class of
-problem by having no compile step at all (blst is AOT); on TPU the
-restart story is "run warmup.py once per binary/kernel revision"
-(docs/architecture.md §compile-cache).
+unique-root flood defense routes here) + the bisection-verdict tree
+kernel per bucket and its fixed-shape probe kernel (the per-set verdict
+path, round 6) + the bench shapes when --bench is given. Device
+decompression is DEFAULT-ON (round 6), so the *_raw kernel variants —
+on-chip signature decode + subgroup checks — are warmed for the same
+shapes by default; LODESTAR_TPU_DEVICE_DECOMPRESS=0 (or
+--no-device-decompress) skips them for hosts that pin the C-tier
+marshal. Reference analog: the reference avoids this class of problem
+by having no compile step at all (blst is AOT); on TPU the restart
+story is "run warmup.py once per binary/kernel revision"
+(docs/architecture.md §compile-cache). The cache location honors
+LODESTAR_TPU_COMPILE_CACHE (utils/jax_env.enable_compile_cache) like
+node.py and bench.py.
 """
 
 from __future__ import annotations
@@ -69,14 +73,16 @@ def prune_cache(limit_gb: float) -> None:
     print(f"pruned {removed} entries -> {total / (1 << 30):.2f} GiB")
 
 
-def warm_production(include_bench: bool, device_decompress: bool = False) -> None:
+def warm_production(include_bench: bool, device_decompress: bool = True) -> None:
     """Compile the production dispatch ladder on the current platform
     (TPU when available — run this at deploy; each shape is one cached
-    XLA executable). `device_decompress` adds the *_raw kernel variants
-    (on-chip signature decode) for every shape in the ladder."""
-    import jax
+    XLA executable). `device_decompress` (default-on, matching the
+    runtime default) adds the *_raw kernel variants (on-chip signature
+    decode) for every shape in the ladder."""
+    from lodestar_tpu.utils.jax_env import enable_compile_cache
 
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    enable_compile_cache(CACHE_DIR)
+    import jax
 
     from __graft_entry__ import (
         _example_arrays,
@@ -87,7 +93,7 @@ def warm_production(include_bench: bool, device_decompress: bool = False) -> Non
 
     buckets = (4, 16, 64, 128) + ((4096,) if include_bench else ())
     grouped = ((16, 8), (64, 64)) + (
-        ((64, 256), (64, 512)) if include_bench else ()
+        ((64, 256), (64, 512), (64, 1024)) if include_bench else ()
     )
     # the pk-grouped dual-axis config: the planner's default
     # (parallel/verifier pk_grouped_configs) — an adversarial unique-root
@@ -110,6 +116,27 @@ def warm_production(include_bench: bool, device_decompress: bool = False) -> Non
         ok = bv.verify_individual(arrs)
         jax.block_until_ready(ok)
         print(f"individual bucket {b}: {time.monotonic() - t0:.1f}s", flush=True)
+        # the bisection-verdict tree (the per-set verdict path's common
+        # case — ONE final exp) per PRODUCTION bucket; a cold compile
+        # here would hit exactly when a batch just failed and verdicts
+        # are urgent. The bench-only 4096 bucket is skipped: the verdict
+        # path never dispatches it (bench's bisect phase runs at 128).
+        if b <= 128:
+            t0 = time.monotonic()
+            root_ok, _levels = bv.verify_bisect_tree(arrs, r_bits)
+            jax.block_until_ready(root_ok)
+            print(f"bisect tree bucket {b}: {time.monotonic() - t0:.1f}s "
+                  f"root_ok={bool(root_ok)}", flush=True)
+    # the fixed-shape bisection probe kernel (ONE compile total)
+    import numpy as np
+    from lodestar_tpu.ops import fp12 as _fp12
+    from lodestar_tpu.parallel.verifier import PROBE_LANES
+
+    t0 = time.monotonic()
+    probe = bv.probe_nodes(np.asarray(_fp12.one((PROBE_LANES,))))
+    jax.block_until_ready(probe)
+    print(f"bisect probe x{PROBE_LANES}: {time.monotonic() - t0:.1f}s",
+          flush=True)
     for rows, lanes in grouped:
         if device_decompress:
             g, a_bits, b_bits, sig_raw = _example_grouped(rows, lanes, raw=True)
@@ -162,9 +189,11 @@ def main() -> None:
     ap.add_argument("--bench", action="store_true",
                     help="also warm the bench shapes (4096-set, 64x256/512)")
     ap.add_argument("--device-decompress", action="store_true",
-                    help="also warm the *_raw kernels (on-chip signature "
-                         "decode; default when LODESTAR_TPU_DEVICE_DECOMPRESS"
-                         " is set)")
+                    help="warm the *_raw kernels (on-chip signature decode; "
+                         "DEFAULT since round 6 — kept for compatibility)")
+    ap.add_argument("--no-device-decompress", action="store_true",
+                    help="skip the *_raw kernels (for hosts pinning the "
+                         "C-tier marshal via LODESTAR_TPU_DEVICE_DECOMPRESS=0)")
     ap.add_argument("--prune-gb", type=float, default=None,
                     help="GC the cache to this many GiB (LRU) and exit")
     args = ap.parse_args()
@@ -174,9 +203,14 @@ def main() -> None:
     if args.dryrun:
         warm_dryrun(args.devices)
         return
-    device_decompress = args.device_decompress or os.environ.get(
-        "LODESTAR_TPU_DEVICE_DECOMPRESS", ""
-    ).lower() in ("1", "true", "on")
+    # mirror the runtime default: raw kernels ON unless explicitly off
+    # (an explicit --device-decompress wins over the env off-switch)
+    env_off = os.environ.get(
+        "LODESTAR_TPU_DEVICE_DECOMPRESS", "1"
+    ).lower() in ("0", "off", "false")
+    device_decompress = args.device_decompress or not (
+        args.no_device_decompress or env_off
+    )
     warm_production(args.bench, device_decompress=device_decompress)
 
 
