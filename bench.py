@@ -452,6 +452,11 @@ def main() -> None:
     em.add_section("stage_seconds", pipeline.stage_snapshot)
     em.add_section("planner", pipeline.planner_snapshot)
     em.add_section("bisect", pipeline.bisect_snapshot)
+    # failure-policy / fault counters (round 7): a round that ran with
+    # CPU fallbacks, an open breaker, or an armed fault plan carries
+    # supervisor.degraded=true — tools/bench_compare.py skips it so a
+    # degraded round can't masquerade as a device-perf regression
+    em.add_section("supervisor", pipeline.supervisor_snapshot)
     em.extra["config"] = {
         "grouped_batch": UNIQUE_ROOTS * GROUPED_LANES,
         "unique_roots_per_batch": UNIQUE_ROOTS,

@@ -8,4 +8,5 @@ pipeline, and fork-choice wiring.
 
 from .bls_verifier import CpuBlsVerifier, IBlsVerifier  # noqa: F401
 from .chain import BeaconChain  # noqa: F401
+from .supervisor import SupervisedBlsVerifier  # noqa: F401
 from .prepare_next_slot import PrepareNextSlotScheduler  # noqa: F401

@@ -322,7 +322,8 @@ class ThreadBufferedVerifier:
 
     def __init__(self, verifier: IBlsVerifier, max_sigs: int = MAX_BUFFERED_SIGS,
                  max_wait_ms: float = MAX_BUFFER_WAIT_MS, prom=None,
-                 pipeline=None):
+                 pipeline=None, waiter_timeout_s: float | None = None):
+        import os
         import threading
 
         from ..observability.stages import default_pipeline
@@ -330,6 +331,16 @@ class ThreadBufferedVerifier:
         self.verifier = verifier
         self.max_sigs = max_sigs
         self.max_wait = max_wait_ms / 1000.0
+        # defense-in-depth: waiters NEVER block forever on the flush
+        # thread (a wedged device call used to deadlock every gossip /
+        # import thread at ev.wait()). Generous by design — the
+        # supervisor's per-dispatch deadline fires far earlier; this is
+        # the last-resort escalation path.
+        if waiter_timeout_s is None:
+            waiter_timeout_s = float(
+                os.environ.get("LODESTAR_TPU_WAITER_TIMEOUT", "300")
+            )
+        self.waiter_timeout = waiter_timeout_s
         self.prom = prom
         self._lock = threading.Lock()
         self._entries: list[tuple[list, object, list]] = []
@@ -389,7 +400,20 @@ class ThreadBufferedVerifier:
                 self._timer.start()
         if flush_now is not None:
             self._run_batch(flush_now, reason="size")
-        ev.wait()
+        if not ev.wait(self.waiter_timeout):
+            # the flush thread is wedged past every deadline the
+            # supervisor enforces — escalate loudly and fail THIS call
+            # rather than deadlock the gossip/import thread forever
+            self.pipeline.waiter_timeout()
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").error(
+                "verify waiter gave up after %.1fs: flush thread wedged "
+                "(%d sets in this request); counted in "
+                "lodestar_bls_verifier_waiter_timeouts_total",
+                self.waiter_timeout, len(sets),
+            )
+            return holder[0] if holder[0] is not None else False
         return holder[0]
 
     def _take_locked(self):
@@ -408,9 +432,12 @@ class ThreadBufferedVerifier:
 
     def _run_batch(self, entries, reason: str = "manual") -> None:
         """Verify a merged batch and resolve every entry — ALWAYS: an
-        exception here (device OOM, preemption) must resolve waiters as
-        False rather than deadlock every blocked gossip/import thread
-        (they hold no timeout on their Event)."""
+        exception here (device OOM, preemption) must resolve waiters
+        rather than hang them (their Event wait has a generous timeout as
+        the last-resort escape, but a resolved verdict beats a timeout).
+        When the wrapped verifier is `SupervisedBlsVerifier`, device
+        failures never reach this except-path — waiters get CPU-oracle
+        verdicts; blanket False remains only for both-tiers-failed."""
         t0 = time.monotonic()
         try:
             per_request = _verify_merged(

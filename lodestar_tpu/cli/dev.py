@@ -46,7 +46,14 @@ def run_dev(args) -> int:
         state.genesis_validators_root.hex()[:16],
     )
 
-    verifier = DeviceBlsVerifier() if args.tpu_verifier else CpuBlsVerifier()
+    if args.tpu_verifier:
+        # same supervised stack as BeaconNode.init: device tier behind
+        # the deadline/retry/fallback/breaker policy (docs/robustness.md)
+        from ..chain import SupervisedBlsVerifier
+
+        verifier = SupervisedBlsVerifier(DeviceBlsVerifier(), CpuBlsVerifier())
+    else:
+        verifier = CpuBlsVerifier()
     chain = BeaconChain(config, types, state, verifier=verifier)
     store = ValidatorStore(config, SlashingProtection(MemoryDb()))
     for i in range(args.validators):

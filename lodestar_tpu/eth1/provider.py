@@ -132,22 +132,33 @@ class Eth1ProviderHttp:
         return resp["result"]
 
     def _call(self, method: str, params: list):
-        last: Exception | None = None
-        for attempt in range(self.retries):
+        """JSON-RPC call through the shared retry helper (`utils/retry`):
+        jittered exponential backoff replaces the round-1 ad-hoc loop
+        whose synchronized sleeps stampeded a recovering endpoint."""
+        from ..utils.retry import RetryPolicy, retry_call
+
+        def _once():
             t0 = time.monotonic()
-            try:
-                out = self._call_once(method, params)
-                if self.metrics is not None:
-                    self.metrics.eth1_request_seconds.observe(
-                        time.monotonic() - t0, method=method
-                    )
-                return out
-            except (OSError, RuntimeError, ValueError) as e:
-                last = e
-                if self.metrics is not None:
-                    self.metrics.eth1_request_errors_total.inc()
-                time.sleep(self.retry_delay * (2**attempt))
-        raise RuntimeError(f"eth1 rpc {method} failed after retries: {last}")
+            out = self._call_once(method, params)
+            if self.metrics is not None:
+                self.metrics.eth1_request_seconds.observe(
+                    time.monotonic() - t0, method=method
+                )
+            return out
+
+        def _on_error(exc, attempt, will_retry):
+            if self.metrics is not None:
+                self.metrics.eth1_request_errors_total.inc()
+
+        policy = RetryPolicy(
+            max_attempts=self.retries,
+            base_delay_s=self.retry_delay,
+            retryable=lambda e: isinstance(e, (OSError, RuntimeError, ValueError)),
+        )
+        try:
+            return retry_call(_once, policy=policy, on_error=_on_error)
+        except (OSError, RuntimeError, ValueError) as e:
+            raise RuntimeError(f"eth1 rpc {method} failed after retries: {e}")
 
     # -- IEth1Provider -------------------------------------------------------
 

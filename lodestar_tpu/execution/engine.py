@@ -216,38 +216,58 @@ class ExecutionEngineHttp:
 
     def __init__(
         self, host: str, port: int, jwt_secret: bytes, timeout: float = 8.0,
-        metrics=None,
+        metrics=None, retries: int = 2,
     ):
+        from ..utils.retry import RetryPolicy, transient_http
+
         self.host = host
         self.port = port
         self.jwt_secret = jwt_secret
         self.timeout = timeout
         self.metrics = metrics
         self._id = 0
+        # transport-level retry (shared utils/retry helper): a dropped
+        # connection to the EL must not surface as SYNCING/ELERROR on a
+        # proposal path. JSON-RPC error REPLIES are never retried — the
+        # EL answered; engine semantics decide what an error means.
+        self._retry_policy = RetryPolicy(
+            max_attempts=1 + max(0, retries),
+            base_delay_s=0.25,
+            max_delay_s=2.0,
+            retryable=transient_http,
+        )
 
     def _call(self, method: str, params: list):
         import http.client
         import time as _time
+
+        from ..utils.retry import retry_call
 
         t0 = _time.monotonic()
         self._id += 1
         body = json.dumps(
             {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
         ).encode()
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
-        try:
-            conn.request(
-                "POST",
-                "/",
-                body=body,
-                headers={
-                    "Content-Type": "application/json",
-                    "Authorization": f"Bearer {_jwt_hs256(self.jwt_secret)}",
-                },
+
+        def _transport():
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
             )
-            resp = json.loads(conn.getresponse().read())
-        finally:
-            conn.close()
+            try:
+                conn.request(
+                    "POST",
+                    "/",
+                    body=body,
+                    headers={
+                        "Content-Type": "application/json",
+                        "Authorization": f"Bearer {_jwt_hs256(self.jwt_secret)}",
+                    },
+                )
+                return json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+
+        resp = retry_call(_transport, policy=self._retry_policy)
         if self.metrics is not None:
             self.metrics.engine_request_seconds.observe(
                 _time.monotonic() - t0, method=method

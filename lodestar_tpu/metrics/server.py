@@ -6,6 +6,12 @@ profiler + lifecycle-trace control surface:
     POST /profiler/stop   stop it; returns the trace directory
     GET /debug/traces     recent lifecycle traces as JSON
                           (?slot=N &root=0x… &limit=K)
+    GET /debug/breaker    device-supervisor circuit-breaker state +
+                          failure-policy counters (chain/supervisor.py)
+    GET /debug/faults     fault-injection plan (testing/faults.py);
+                          ?set=<spec> arms it, ?clear=1 disarms — the
+                          live chaos-drill control surface
+                          (docs/robustness.md)
 
 (GET also accepted on the profiler routes — operator curl ergonomics.)
 The profiler hooks default to `observability.trace`, the same process-
@@ -32,6 +38,7 @@ class MetricsServer:
         profiler_start=None,
         profiler_stop=None,
         tracer=None,
+        breaker=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -106,6 +113,37 @@ class MetricsServer:
                             "traces": docs,
                         },
                     )
+                    return
+                if route == "/debug/breaker":
+                    # breaker = zero-arg callable returning the
+                    # supervisor's breaker_snapshot(); unwired nodes
+                    # (CPU-only verifier) report wired: false
+                    if breaker is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    try:
+                        doc = {"wired": True, **breaker()}
+                    except Exception as e:
+                        self._send_json(500, {"error": str(e)})
+                        return
+                    self._send_json(200, doc)
+                    return
+                if route == "/debug/faults":
+                    from ..testing import faults
+
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        if "set" in q:
+                            doc = faults.configure(q["set"][0])
+                        elif "clear" in q:
+                            faults.clear()
+                            doc = faults.snapshot()
+                        else:
+                            doc = faults.snapshot()
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                    self._send_json(200, doc)
                     return
                 if route not in ("", "/metrics"):
                     self.send_response(404)

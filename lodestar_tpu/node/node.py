@@ -109,15 +109,25 @@ class BeaconNode:
                 DeviceBlsVerifier,
                 ThreadBufferedVerifier,
             )
+            from ..chain.supervisor import SupervisedBlsVerifier
 
             # pipeline telemetry rides the node registry: stage timers +
             # planner counters from the device tier, flush/queue gauges
-            # from the batching facade — all on /metrics by default
-            verifier = ThreadBufferedVerifier(
+            # from the batching facade — all on /metrics by default.
+            # The supervisor between them owns the failure policy:
+            # per-dispatch deadlines, one retry, CPU-oracle fallback and
+            # the circuit breaker (docs/robustness.md) — a device outage
+            # degrades throughput instead of rejecting valid blocks
+            self.bls_supervisor = SupervisedBlsVerifier(
                 DeviceBlsVerifier(observer=self.metrics.pipeline),
-                prom=self.metrics,
+                CpuBlsVerifier(),
+                observer=self.metrics.pipeline,
+            )
+            verifier = ThreadBufferedVerifier(
+                self.bls_supervisor, prom=self.metrics,
             )
         else:
+            self.bls_supervisor = None
             verifier = CpuBlsVerifier()
         self.chain = BeaconChain(
             config,
@@ -164,6 +174,11 @@ class BeaconNode:
             self.metrics_server = MetricsServer(
                 self.metrics.registry, port=opts.metrics_port,
                 tracer=self.tracer,
+                breaker=(
+                    self.bls_supervisor.breaker_snapshot
+                    if self.bls_supervisor is not None
+                    else None
+                ),
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)
@@ -325,5 +340,7 @@ class BeaconNode:
         stopper = getattr(self.chain.bls, "stop_profiling", None)
         if callable(stopper):
             stopper()  # flush the XLA trace (LODESTAR_TPU_PROFILE)
+        if getattr(self, "bls_supervisor", None) is not None:
+            self.bls_supervisor.close()  # stop canary + dispatch worker
         self.chain._verify_pool.shutdown(wait=False)
         self.db.close()

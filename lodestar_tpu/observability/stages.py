@@ -174,6 +174,56 @@ class PipelineMetrics:
             "device-decompress batches downgraded to host marshal "
             "(native tier ineligible for the batch shape)",
         )
+        # supervisor / failure-policy families (round 7): the device tier
+        # is allowed to fail — these make every branch of the failure
+        # policy (chain/supervisor.py) visible. Breaker state encodes
+        # closed=0 / half_open=1 / open=2 so dashboards can alert on
+        # `> 0` (any degradation) or `== 2` (hard open).
+        self.supervisor_breaker_state = r.gauge(
+            "lodestar_bls_supervisor_breaker_state",
+            "device circuit breaker state (0=closed, 1=half_open, 2=open)",
+        )
+        self.supervisor_transitions = r.counter(
+            "lodestar_bls_supervisor_breaker_transitions_total",
+            "circuit breaker state transitions by destination state",
+            label_names=("to",),
+        )
+        self.supervisor_retries = r.counter(
+            "lodestar_bls_supervisor_retries_total",
+            "device dispatches retried after a transient error",
+        )
+        self.supervisor_fallbacks = r.counter(
+            "lodestar_bls_supervisor_fallbacks_total",
+            "dispatches served by the CPU oracle tier, by reason "
+            "(exception/deadline/breaker_open/negative_audit)",
+            label_names=("reason",),
+        )
+        self.supervisor_deadline_exceeded = r.counter(
+            "lodestar_bls_supervisor_deadline_exceeded_total",
+            "device dispatches abandoned at the per-dispatch deadline",
+        )
+        self.supervisor_canary = r.counter(
+            "lodestar_bls_supervisor_canary_probes_total",
+            "half-open canary-batch probes by outcome (ok/fail)",
+            label_names=("outcome",),
+        )
+        self.supervisor_both_tiers_failed = r.counter(
+            "lodestar_bls_supervisor_both_tiers_failed_total",
+            "batches where the device AND the CPU oracle both failed "
+            "(waiters resolved False — the only blanket-False path left)",
+        )
+        self.supervisor_verdict_mismatches = r.counter(
+            "lodestar_bls_supervisor_verdict_mismatch_total",
+            "device-negative verdicts the CPU oracle overturned "
+            "(flaky-device evidence; feeds the breaker)",
+        )
+        # defense-in-depth for blocked waiters (round-7 satellite): a
+        # wedged flush thread must escalate, not silently deadlock every
+        # gossip/import thread
+        self.waiter_timeouts = r.counter(
+            "lodestar_bls_verifier_waiter_timeouts_total",
+            "verify waiters that gave up after the flush-thread timeout",
+        )
         # device-busy sampler state: busy seconds accumulate per resolve,
         # the fraction is re-sampled over >=1 s wall windows
         self._busy_lock = threading.Lock()
@@ -213,6 +263,36 @@ class PipelineMetrics:
 
     def decompress_fallback(self, n: int = 1) -> None:
         self.decompress_fallbacks.inc(n)
+
+    # -- supervisor / failure policy ----------------------------------------
+
+    def breaker_state(self, value: int, to: str | None = None) -> None:
+        """Set the breaker-state gauge; `to` also ticks the transition
+        counter (passed on actual transitions, not on re-assertions)."""
+        self.supervisor_breaker_state.set(value)
+        if to is not None:
+            self.supervisor_transitions.inc(to=to)
+
+    def supervisor_retry(self) -> None:
+        self.supervisor_retries.inc()
+
+    def supervisor_fallback(self, reason: str, n_sets: int = 0) -> None:
+        self.supervisor_fallbacks.inc(reason=reason)
+
+    def supervisor_deadline(self) -> None:
+        self.supervisor_deadline_exceeded.inc()
+
+    def supervisor_canary_probe(self, ok: bool) -> None:
+        self.supervisor_canary.inc(outcome="ok" if ok else "fail")
+
+    def both_tiers_failed(self) -> None:
+        self.supervisor_both_tiers_failed.inc()
+
+    def verdict_mismatch(self, n: int = 1) -> None:
+        self.supervisor_verdict_mismatches.inc(n)
+
+    def waiter_timeout(self) -> None:
+        self.waiter_timeouts.inc()
 
     # -- queue / flush ------------------------------------------------------
 
@@ -279,6 +359,54 @@ class PipelineMetrics:
             "probes": int(self.bisect_probes_total.value()),
             "decompress_fallbacks": int(self.decompress_fallbacks.value()),
         }
+
+    def supervisor_snapshot(self) -> dict:
+        """Failure-policy counters for the bench document and
+        `/debug/breaker`. `degraded` is the one-bit summary the bench
+        regression gate keys on: a round that ran any CPU fallback, an
+        open breaker, or an armed fault plan is not comparing the device
+        path and must not gate device-perf history."""
+        from ..testing import faults
+
+        fallbacks = {
+            labels.get("reason", ""): int(v)
+            for labels, v in self.supervisor_fallbacks.collect()
+        }
+        canary = {
+            labels.get("outcome", ""): int(v)
+            for labels, v in self.supervisor_canary.collect()
+        }
+        fault_snap = faults.snapshot()
+        snap = {
+            "breaker_state": int(self.supervisor_breaker_state.value()),
+            "fallbacks": fallbacks,
+            "retries": int(self.supervisor_retries.value()),
+            "deadline_exceeded": int(self.supervisor_deadline_exceeded.value()),
+            "canary": canary,
+            "both_tiers_failed": int(
+                self.supervisor_both_tiers_failed.value()
+            ),
+            "verdict_mismatches": int(
+                self.supervisor_verdict_mismatches.value()
+            ),
+            "waiter_timeouts": int(self.waiter_timeouts.value()),
+            "faults": fault_snap,
+        }
+        # negative_audit alone is NOT degradation: auditing a genuinely
+        # invalid batch on the oracle is the healthy-path design
+        tier_fallbacks = sum(
+            v for k, v in fallbacks.items() if k != "negative_audit"
+        )
+        snap["degraded"] = bool(
+            snap["breaker_state"]
+            or tier_fallbacks
+            or snap["deadline_exceeded"]
+            or snap["both_tiers_failed"]
+            or snap["verdict_mismatches"]
+            or fault_snap["active"]
+            or fault_snap["injected"]
+        )
+        return snap
 
 
 def create_pipeline_metrics(registry: MetricsRegistry) -> PipelineMetrics:

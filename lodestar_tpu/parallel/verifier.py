@@ -42,6 +42,7 @@ from ..bls import api as bls_api
 from ..bls.hash_to_curve import hash_to_g2
 from ..observability.stages import default_pipeline
 from ..observability.trace import named_scope
+from ..testing import faults as _faults
 from ..ops import fp, fp2, fp12, msm
 from ..ops.g2_decompress import decompress as _g2_decompress, planes_in_subgroup as _planes_in_subgroup
 from ..ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
@@ -1218,6 +1219,10 @@ class TpuBlsVerifier:
         (main thread aggregates the next job while workers verify,
         `chain/bls/interface.ts:30-35`). `verify_signature_sets` is
         submit-then-resolve with no batch behind it."""
+        # fault-injection seam (testing.faults): no-op unless a plan is
+        # armed via LODESTAR_TPU_FAULTS or /debug/faults — the supervisor
+        # tier's failure policy is exercised against exactly this boundary
+        _faults.on_device_dispatch(len(sets))
         if sets and self._native_eligible(sets):
             plan = self._plan_groups(sets)
             if plan is not None:
@@ -1275,7 +1280,9 @@ class TpuBlsVerifier:
         self.observer.device_busy_sample(
             now - (t_submit if t_submit is not None else t0)
         )
-        return verdict
+        # flaky-verdict injection (testing.faults): True -> False only,
+        # modeling corrupted device computation
+        return _faults.flaky_verdict(verdict)
 
     def _submit_grouped(self, sets, plan):
         """Dispatch one grouped-kernel batch; None marks an invalid set
@@ -1350,6 +1357,7 @@ class TpuBlsVerifier:
         internal short-circuits carry the same 2^-64 soundness as batch
         verification itself."""
         self.observer.planner("individual", len(sets))
+        _faults.on_device_dispatch(len(sets))
         with self.observer.stage("marshal"):
             arrs = self._marshal(sets)
         if arrs is None:
@@ -1365,9 +1373,9 @@ class TpuBlsVerifier:
         self.observer.device_busy_sample(time.monotonic() - t)
         if root_ok:
             self.observer.bisect(rounds=0, probes=0)
-            return [True] * arrs.n
+            return _faults.flaky_verdicts([True] * arrs.n)
         verdicts = self._bisect(arrs, levels)
-        return [bool(v) for v in verdicts[: arrs.n]]
+        return _faults.flaky_verdicts([bool(v) for v in verdicts[: arrs.n]])
 
     def _bisect(self, arrs, levels) -> np.ndarray:
         """Binary-search a failed product tree for the invalid leaves.

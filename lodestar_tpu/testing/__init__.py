@@ -1,0 +1,7 @@
+"""Test/chaos-drill utilities that ship in the production tree.
+
+`faults` is the env- and endpoint-driven fault-injection seam at the
+device verifier boundary — importable from production code (the hooks
+are no-ops unless armed), so live chaos drills exercise exactly the
+code paths the supervisor tests do.
+"""

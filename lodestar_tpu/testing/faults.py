@@ -1,0 +1,165 @@
+"""Fault injection at the device BLS verifier boundary.
+
+The supervisor's whole failure policy (`chain/supervisor.py`: deadlines,
+retry, CPU fallback, circuit breaker) is only trustworthy if every
+branch can be driven on demand — in unit tests AND against a live node
+(chaos drill, docs/robustness.md runbook). This module is that seam:
+`TpuBlsVerifier` calls the two hooks below on every device dispatch, and
+they are no-ops (one attribute load + `is None` test) unless a fault
+plan is armed via:
+
+- the environment: ``LODESTAR_TPU_FAULTS="exception,latency:0.05"``
+  (read at import, so a whole test process or drill node starts faulty);
+- the metrics server: ``POST /debug/faults?set=deadline:30`` /
+  ``?clear=1`` (live toggling mid-drill, no restart).
+
+Modes (comma-separated, each with an optional ``:param``):
+
+    exception[:rate]   raise InjectedFault on a dispatch (rate = probability,
+                       default 1.0) — the transient-XLA-error shape
+                       (OOM, preemption, backend reset)
+    latency[:seconds]  sleep before dispatching (default 0.05 s) — a slow
+                       but live device; exercises deadline headroom
+    deadline[:seconds] sleep long (default 30 s) — a wedged dispatch
+                       (cold compile, hung transfer); the supervisor's
+                       watchdog must abandon it
+    flaky[:rate]       corrupt verdicts: True -> False with probability
+                       `rate` (default 1.0). One-directional by design:
+                       random hardware corruption yields a pairing
+                       product that is NOT the identity, i.e. a spurious
+                       False — it cannot forge the unique identity
+                       element, so False -> True is not a physical
+                       failure mode. The supervisor's negative-verdict
+                       audit must rescue these on the CPU oracle.
+
+Injections are counted per mode (`snapshot()`), and the counts ride the
+bench document's `supervisor` section so a benchmark run that executed
+with faults armed is self-labelling (tools/bench_compare.py skips it).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+
+class InjectedFault(RuntimeError):
+    """Synthetic transient device failure (stands in for an XLA error)."""
+
+
+_MODE_DEFAULTS = {
+    "exception": 1.0,   # probability
+    "latency": 0.05,    # seconds
+    "deadline": 30.0,   # seconds
+    "flaky": 1.0,       # probability
+}
+
+_lock = threading.Lock()
+_plan: dict[str, float] | None = None
+_injected: dict[str, int] = {}
+_rand = random.random
+_sleep = time.sleep
+
+
+def _parse(spec: str) -> dict[str, float]:
+    plan: dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, param = part.partition(":")
+        name = name.strip()
+        if name not in _MODE_DEFAULTS:
+            raise ValueError(
+                f"unknown fault mode {name!r} (known: {sorted(_MODE_DEFAULTS)})"
+            )
+        plan[name] = float(param) if param else _MODE_DEFAULTS[name]
+    return plan
+
+
+def configure(spec: str | None) -> dict:
+    """Arm the plan from a spec string (None/empty disarms); returns
+    `snapshot()`. Raises ValueError on an unknown mode name."""
+    global _plan
+    plan = _parse(spec) if spec else None
+    with _lock:
+        _plan = plan or None
+    return snapshot()
+
+
+def clear(reset_counters: bool = False) -> None:
+    """Disarm the plan. Injection counters persist by default — a bench
+    round that ran ANY injection stays self-labelled as degraded even if
+    the plan was cleared mid-run; tests pass `reset_counters=True` for
+    isolation."""
+    global _plan
+    with _lock:
+        _plan = None
+        if reset_counters:
+            _injected.clear()
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def snapshot() -> dict:
+    with _lock:
+        return {
+            "active": _plan is not None,
+            "modes": dict(_plan) if _plan else {},
+            "injected": dict(_injected),
+        }
+
+
+def _count(mode: str) -> None:
+    with _lock:
+        _injected[mode] = _injected.get(mode, 0) + 1
+
+
+def on_device_dispatch(n_sets: int) -> None:
+    """Called by `TpuBlsVerifier` before every device dispatch. May
+    sleep (latency/deadline) and/or raise InjectedFault (exception)."""
+    plan = _plan
+    if plan is None:
+        return
+    if "latency" in plan:
+        _count("latency")
+        _sleep(plan["latency"])
+    if "deadline" in plan:
+        _count("deadline")
+        _sleep(plan["deadline"])
+    rate = plan.get("exception")
+    if rate is not None and _rand() < rate:
+        _count("exception")
+        raise InjectedFault(
+            f"injected device fault (batch of {n_sets} sets)"
+        )
+
+
+def flaky_verdict(verdict: bool) -> bool:
+    """Corrupt one batch-level verdict (True -> False w.p. rate)."""
+    plan = _plan
+    if plan is None or "flaky" not in plan or not verdict:
+        return verdict
+    if _rand() < plan["flaky"]:
+        _count("flaky")
+        return False
+    return verdict
+
+
+def flaky_verdicts(verdicts: list[bool]) -> list[bool]:
+    """Corrupt per-set verdicts independently (True -> False w.p. rate)."""
+    plan = _plan
+    if plan is None or "flaky" not in plan:
+        return verdicts
+    return [flaky_verdict(v) for v in verdicts]
+
+
+# arm from the environment at import: a drill node (or a fault-injected
+# test subprocess) starts with the plan already live
+_env_spec = os.environ.get("LODESTAR_TPU_FAULTS")
+if _env_spec:
+    configure(_env_spec)

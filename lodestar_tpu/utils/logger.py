@@ -53,3 +53,43 @@ def get_logger(module: str, level: int | None = None) -> logging.Logger:
     if level is not None:
         logger.setLevel(level)
     return logger
+
+
+class RateLimitedLogger:
+    """Per-key rate limiter over a logger: failure paths that can fire
+    per-dispatch (device fallback, breaker rejections) must not turn a
+    degraded hour into a gigabyte of identical lines. Suppressed calls
+    are counted and the count is prepended to the next emitted line."""
+
+    def __init__(self, logger: logging.Logger, interval_s: float = 30.0):
+        import threading
+        import time as _time
+
+        self._logger = logger
+        self._interval = interval_s
+        self._last: dict[str, float] = {}
+        self._suppressed: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._now = _time.monotonic
+
+    def log(self, level: int, key: str, msg: str, *args) -> bool:
+        """Emit at most once per `interval_s` per `key`; returns whether
+        the line was emitted."""
+        now = self._now()
+        with self._lock:
+            last = self._last.get(key, float("-inf"))
+            if now - last < self._interval:
+                self._suppressed[key] = self._suppressed.get(key, 0) + 1
+                return False
+            self._last[key] = now
+            skipped, self._suppressed[key] = self._suppressed.get(key, 0), 0
+        if skipped:
+            msg = f"(+{skipped} suppressed) " + msg
+        self._logger.log(level, msg, *args)
+        return True
+
+    def warning(self, key: str, msg: str, *args) -> bool:
+        return self.log(logging.WARNING, key, msg, *args)
+
+    def error(self, key: str, msg: str, *args) -> bool:
+        return self.log(logging.ERROR, key, msg, *args)

@@ -1,0 +1,81 @@
+"""Shared retry helper: jittered exponential backoff behind one policy
+object.
+
+Before round 7 every client carried its own ad-hoc loop (eth1 JSON-RPC
+retried with bare exponential sleeps, the engine and signer clients did
+not retry at all, and the device supervisor needed a third copy), so the
+thundering-herd and max-delay fixes never landed in the same place
+twice. `RetryPolicy` + `retry_call` is the single copy: the eth1
+provider, the engine client, the external signer, `json_http_request`,
+and `chain/supervisor.py` all route through it.
+
+The jitter is symmetric (delay x (1 +/- jitter)) so N nodes restarting
+against the same dead endpoint don't re-synchronize their retries — the
+classic correlated-retry stampede (AWS architecture blog's "exponential
+backoff and jitter").
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+def _always(exc: BaseException) -> bool:
+    return True
+
+
+@dataclass
+class RetryPolicy:
+    """max_attempts TOTAL tries (1 = no retry); delays grow
+    base_delay_s * 2^k, capped at max_delay_s, jittered +/- `jitter`
+    fraction. `retryable(exc)` gates which failures are worth retrying
+    (a 404 isn't; a connection reset is)."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.5
+    max_delay_s: float = 30.0
+    jitter: float = 0.25
+    retryable: Callable[[BaseException], bool] = _always
+    sleep: Callable[[float], None] = time.sleep
+    rand: Callable[[], float] = field(default=random.random)
+
+    def delay_s(self, failure_index: int) -> float:
+        """Jittered backoff delay after the (failure_index+1)-th failure."""
+        base = min(self.max_delay_s, self.base_delay_s * (2.0 ** failure_index))
+        if self.jitter <= 0:
+            return base
+        return max(0.0, base * (1.0 + self.jitter * (2.0 * self.rand() - 1.0)))
+
+
+def transient_http(exc: BaseException) -> bool:
+    """The transport-level failures every HTTP/JSON-RPC client should
+    retry: socket errors and protocol breakage — never application-level
+    error replies (those raised as custom error classes don't match)."""
+    import http.client
+
+    return isinstance(exc, (OSError, http.client.HTTPException))
+
+
+def retry_call(fn, *, policy: RetryPolicy | None = None, on_error=None):
+    """Call `fn()` under `policy`; re-raises the last exception once
+    attempts are exhausted or the failure is not retryable.
+
+    `on_error(exc, attempt, will_retry)` fires on EVERY failed attempt
+    (attempt is 0-based) so callers can keep their error counters
+    exactly as the old ad-hoc loops did."""
+    policy = policy or RetryPolicy()
+    attempts = max(1, int(policy.max_attempts))
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            will_retry = attempt + 1 < attempts and policy.retryable(e)
+            if on_error is not None:
+                on_error(e, attempt, will_retry)
+            if not will_retry:
+                raise
+            policy.sleep(policy.delay_s(attempt))
+    raise AssertionError("unreachable")  # pragma: no cover

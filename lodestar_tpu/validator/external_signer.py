@@ -23,10 +23,15 @@ class ExternalSignerError(Exception):
 class ExternalSignerClient:
     """Blocking HTTP client to a web3signer-compatible endpoint."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 retries: int = 2):
         self.host = host
         self.port = port
         self.timeout = timeout
+        # transport blips to the signer retry through utils/retry (signing
+        # is idempotent: same root -> same signature); HTTP error replies
+        # (unknown pubkey, slashing-protection refusal) never do
+        self.retries = retries
 
     def _request(self, method: str, path: str, body=None):
         from ..utils.http import json_http_request
@@ -34,6 +39,7 @@ class ExternalSignerClient:
         return json_http_request(
             self.host, self.port, method, path, body,
             timeout=self.timeout, error_cls=ExternalSignerError,
+            retries=self.retries,
         )
 
     def list_pubkeys(self) -> list[bytes]:

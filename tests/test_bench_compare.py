@@ -155,6 +155,42 @@ def test_required_key_missing_fails(tmp_path, capsys):
     assert "missing from current round" in capsys.readouterr().out
 
 
+def test_degraded_rounds_are_skipped(tmp_path, capsys):
+    """A round that ran with CPU fallbacks / open breaker / armed faults
+    (supervisor.degraded — round 7) measures the wrong tier: it must be
+    skipped with a note, never gated, in EITHER direction — its terrible
+    numbers are not a regression, and a later healthy round recovering
+    from them is not a 10x win."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    _round(tmp_path, 2, 900.0, extra={  # 10x "drop" — but CPU-tier numbers
+        "supervisor": {"degraded": True,
+                       "fallbacks": {"breaker_open": 41},
+                       "breaker_state": 2},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out and "nothing to gate" in out
+    _round(tmp_path, 3, 8800.0)  # healthy again: compared against r01
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "r01 -> r03" in capsys.readouterr().out
+    # a degraded details file must not augment the latest healthy round
+    details = tmp_path / "bench_details.json"
+    details.write_text(json.dumps({
+        "metric": "bls_signature_sets_verified_per_sec",
+        "value": 700.0,
+        "supervisor": {"degraded": True},
+    }))
+    assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 0
+    capsys.readouterr()
+    # a healthy supervisor section (degraded: false) still gates normally
+    _round(tmp_path, 4, 2000.0, extra={  # 4.4x real drop, not degraded
+        "supervisor": {"degraded": False, "breaker_state": 0},
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    capsys.readouterr()
+
+
 def test_required_key_improvement_passes(tmp_path, capsys):
     """The round-6 re-bind (e2e_wire_to_verdict now the device-decompress
     default path, ~6x faster) is an IMPROVEMENT and must pass."""

@@ -1,0 +1,196 @@
+"""utils/retry.py: the shared jittered-exponential-backoff helper
+(round-7 satellite) and the clients migrated onto it (eth1 provider,
+engine client, external signer, json_http_request)."""
+
+import pytest
+
+from lodestar_tpu.utils.retry import RetryPolicy, retry_call, transient_http
+
+
+def _policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+def test_succeeds_after_transient_failures():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("reset")
+        return "ok"
+
+    assert retry_call(fn, policy=_policy(max_attempts=3)) == "ok"
+    assert len(calls) == 3
+
+
+def test_exhausted_attempts_reraise_last_error():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError(f"boom {len(calls)}")
+
+    with pytest.raises(OSError, match="boom 2"):
+        retry_call(fn, policy=_policy(max_attempts=2))
+    assert len(calls) == 2
+
+
+def test_non_retryable_raises_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("bad input")
+
+    policy = _policy(
+        max_attempts=5, retryable=lambda e: isinstance(e, OSError)
+    )
+    with pytest.raises(ValueError):
+        retry_call(fn, policy=policy)
+    assert len(calls) == 1
+
+
+def test_on_error_fires_for_every_failed_attempt():
+    seen = []
+
+    def fn():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(
+            fn,
+            policy=_policy(max_attempts=3),
+            on_error=lambda e, attempt, will_retry: seen.append(
+                (attempt, will_retry)
+            ),
+        )
+    # the final attempt reports will_retry=False (the old ad-hoc loops
+    # counted their error metric on every failure, including the last)
+    assert seen == [(0, True), (1, True), (2, False)]
+
+
+def test_backoff_doubles_capped_and_jittered():
+    slept = []
+    policy = RetryPolicy(
+        max_attempts=5,
+        base_delay_s=1.0,
+        max_delay_s=3.0,
+        jitter=0.25,
+        sleep=slept.append,
+        rand=lambda: 1.0,  # worst-case high jitter
+    )
+
+    def fn():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(fn, policy=policy)
+    # bases 1, 2, min(4,3)=3, min(8,3)=3 — each x1.25 at rand()=1.0
+    assert slept == pytest.approx([1.25, 2.5, 3.75, 3.75])
+    # rand()=0.0 gives the low edge; delays stay non-negative
+    assert policy.delay_s(0) == 1.25
+    policy.rand = lambda: 0.0
+    assert policy.delay_s(0) == pytest.approx(0.75)
+
+
+def test_zero_jitter_is_deterministic():
+    policy = _policy(max_attempts=2, base_delay_s=0.5, jitter=0.0)
+    assert policy.delay_s(0) == 0.5
+    assert policy.delay_s(3) == 4.0
+
+
+def test_transient_http_predicate():
+    import http.client
+
+    assert transient_http(OSError("reset"))
+    assert transient_http(http.client.BadStatusLine("x"))
+    assert not transient_http(RuntimeError("500: server said no"))
+
+
+# --- migrated clients --------------------------------------------------------
+
+
+def test_eth1_provider_retries_through_shared_helper(monkeypatch):
+    """Eth1ProviderHttp._call: two transport failures then success — the
+    shared policy must deliver the result and count every error."""
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.eth1.provider import Eth1ProviderHttp
+
+    provider = Eth1ProviderHttp(
+        MINIMAL_CHAIN_CONFIG, None, "127.0.0.1", 1,
+        retries=3, retry_delay=0.0,
+    )
+    calls = []
+
+    def flaky(method, params):
+        calls.append(method)
+        if len(calls) < 3:
+            raise OSError("connection refused")
+        return "0x10"
+
+    monkeypatch.setattr(provider, "_call_once", flaky)
+    assert provider._call("eth_blockNumber", []) == "0x10"
+    assert len(calls) == 3
+
+
+def test_eth1_provider_wraps_final_error(monkeypatch):
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG
+    from lodestar_tpu.eth1.provider import Eth1ProviderHttp
+
+    provider = Eth1ProviderHttp(
+        MINIMAL_CHAIN_CONFIG, None, "127.0.0.1", 1,
+        retries=2, retry_delay=0.0,
+    )
+    monkeypatch.setattr(
+        provider, "_call_once",
+        lambda m, p: (_ for _ in ()).throw(OSError("down")),
+    )
+    with pytest.raises(RuntimeError, match="failed after retries"):
+        provider._call("eth_blockNumber", [])
+
+
+def test_json_http_request_retries_transport_only(monkeypatch):
+    """retries>0 re-issues on socket errors but NEVER on an HTTP error
+    status (the server answered; replaying a non-idempotent request is
+    the caller's call)."""
+    import lodestar_tpu.utils.http as http_mod
+
+    attempts = []
+
+    class FakeResp:
+        status = 503
+
+        def read(self):
+            return b'{"msg": "busy"}'
+
+    class FakeConn:
+        def __init__(self, *a, **kw):
+            pass
+
+        def request(self, *a, **kw):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise OSError("reset by peer")
+
+        def getresponse(self):
+            return FakeResp()
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(http_mod.http.client, "HTTPConnection", FakeConn)
+    from lodestar_tpu.utils.retry import RetryPolicy, transient_http
+
+    policy = RetryPolicy(
+        max_attempts=4, base_delay_s=0.0, sleep=lambda s: None,
+        retryable=transient_http,
+    )
+    # attempt 1: OSError (retried); attempt 2: HTTP 503 -> error_cls raised,
+    # NOT retried despite attempts remaining
+    with pytest.raises(RuntimeError, match="503"):
+        http_mod.json_http_request(
+            "h", 1, "GET", "/x", retry_policy=policy
+        )
+    assert len(attempts) == 2

@@ -16,6 +16,11 @@ Comparison rules:
 - rounds whose document never parsed (`parsed: null` — a timed-out run)
   carry no comparable rows and are skipped, exactly like the reference
   skips benchmarks with no prior history;
+- rounds that ran DEGRADED (`supervisor.degraded: true` in the bench
+  document: CPU-oracle fallbacks, an open circuit breaker, or an armed
+  fault-injection plan — round 7) are skipped with a printed note: a
+  round served by the CPU tier measures the wrong thing, and gating on
+  it would either mask a device regression or flag a phantom one;
 - rate-shaped keys (`*per_sec`) regress when they DROP by more than
   threshold; time-shaped keys (`*_s`, `*_ms`, `*_seconds`) regress when
   they GROW by more than threshold; other keys (counts, fractions,
@@ -83,10 +88,18 @@ def _numeric_rows(doc: dict) -> dict[str, float]:
     return rows
 
 
+def _is_degraded(doc) -> bool:
+    """A bench document that ran with CPU fallbacks / open breaker /
+    armed faults labels itself via the emitter's `supervisor` section."""
+    sup = doc.get("supervisor") if isinstance(doc, dict) else None
+    return bool(isinstance(sup, dict) and sup.get("degraded"))
+
+
 def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
-    """[{n, rows}] for every round whose bench document parsed, ascending
-    by round number. `details_path` (bench_details.json) augments the
-    LATEST round with its full per-phase row set."""
+    """[{n, rows}] for every round whose bench document parsed AND ran
+    non-degraded, ascending by round number. `details_path`
+    (bench_details.json) augments the LATEST round with its full
+    per-phase row set (unless that document is itself degraded)."""
     rounds = []
     for path in glob.glob(os.path.join(root_dir, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -96,13 +109,24 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
             rec = json.load(open(path))
         except (OSError, ValueError):
             continue
-        rows = _numeric_rows(rec.get("parsed") or {})
+        parsed = rec.get("parsed") or {}
+        if _is_degraded(parsed):
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — ran "
+                "DEGRADED (CPU fallback / open breaker / faults armed); "
+                "not comparable to device-path rounds"
+            )
+            continue
+        rows = _numeric_rows(parsed)
         if rows:
             rounds.append({"n": int(m.group(1)), "rows": rows})
     rounds.sort(key=lambda r: r["n"])
     if rounds and details_path and os.path.exists(details_path):
         try:
-            detail_rows = _numeric_rows(json.load(open(details_path)))
+            detail_doc = json.load(open(details_path))
+            detail_rows = (
+                {} if _is_degraded(detail_doc) else _numeric_rows(detail_doc)
+            )
         except (OSError, ValueError):
             detail_rows = {}
         # details belong to the newest run: augment without overriding

@@ -41,6 +41,16 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_bls_verifier_bisect_rounds_total",
         "lodestar_bls_verifier_bisect_probes_total",
         "lodestar_bls_verifier_decompress_fallback_total",
+        # round-7 failure-policy families (ISSUE 4): the supervisor's
+        # breaker/fallback/deadline state must be VISIBLE, not just
+        # registered — a silent CPU-fallback node looks healthy on every
+        # other panel
+        "lodestar_bls_supervisor_breaker_state",
+        "lodestar_bls_supervisor_fallbacks_total",
+        "lodestar_bls_supervisor_deadline_exceeded_total",
+        "lodestar_bls_supervisor_retries_total",
+        "lodestar_bls_supervisor_both_tiers_failed_total",
+        "lodestar_bls_verifier_waiter_timeouts_total",
     ),
 }
 
