@@ -139,6 +139,20 @@ class DeviceBlsVerifier:
     def h2c_cache_size(self) -> int:
         return len(self._inner._h2c_cache)
 
+    # -- mesh passthroughs (supervisor failure policy; parallel/mesh) -------
+
+    def mesh_evict(self, chip: int | None = None, reason: str = "failure"):
+        return self._inner.mesh_evict(chip=chip, reason=reason)
+
+    def mesh_readmit(self) -> int:
+        return self._inner.mesh_readmit()
+
+    def mesh_has_evicted(self) -> bool:
+        return self._inner.mesh_has_evicted()
+
+    def mesh_snapshot(self):
+        return self._inner.mesh_snapshot()
+
     def _note_decompress_fallback(self, sets) -> None:
         """Count + rate-limited-log a device-decompress batch downgraded
         to host marshal because `_native_eligible` rejected its shape —

@@ -29,6 +29,15 @@ policy between the facades and `DeviceBlsVerifier`:
   spurious True). So device-False verdicts are re-checked on the CPU
   oracle; an overturned verdict counts as a device failure and feeds
   the breaker. All-valid steady state pays zero CPU work.
+- **Mesh chip eviction** (round 7) — when the device tier serves from a
+  chip mesh (`parallel/mesh.BlsMeshDispatcher`), a sick chip is treated
+  like a sick device in miniature: the failed dispatch evicts the
+  suspect chip (attributed via the exception's `chip` field when
+  available), the call retries immediately on the surviving mesh, and
+  serving continues — no breaker trip, no CPU fallback, a 4-chip node
+  degrades to a 3-chip one visibly (`lodestar_bls_mesh_*` gauges). The
+  canary thread keeps probing while chips are evicted and re-admits the
+  full census once a probe passes.
 - **Circuit breaker** — N consecutive device failures
   (`LODESTAR_TPU_BREAKER_THRESHOLD`, default 3) open the breaker:
   traffic routes straight to the CPU tier with no per-call deadline
@@ -339,6 +348,14 @@ class SupervisedBlsVerifier:
         if opened_at is not None and state != BREAKER_CLOSED:
             doc["open_for_s"] = round(self._time() - opened_at, 3)
         doc["counters"] = self.observer.supervisor_snapshot()
+        mesh_snap = getattr(self.device, "mesh_snapshot", None)
+        if mesh_snap is not None:
+            try:
+                m = mesh_snap()
+            except Exception:  # pragma: no cover
+                m = None
+            if m is not None:
+                doc["mesh"] = m
         return doc
 
     # -- canary ----------------------------------------------------------------
@@ -361,24 +378,48 @@ class SupervisedBlsVerifier:
             self._canary_sets = sets
         return self._canary_sets
 
+    def _mesh_has_evicted(self) -> bool:
+        try:
+            fn = getattr(self.device, "mesh_has_evicted", None)
+            return bool(fn()) if fn is not None else False
+        except Exception:  # pragma: no cover — introspection must not raise
+            return False
+
     def probe(self) -> bool:
         """One canary probe: open -> half_open -> device dispatch of a
         known-valid batch; success re-closes the breaker, failure
         re-opens it. Production traffic never rides half_open — only
-        this probe risks the device."""
+        this probe risks the device.
+
+        Mesh re-admission rides the same probe: evicted chips are
+        restored FIRST, so the canary batch validates the full mesh — a
+        still-sick chip fails the probe and is re-evicted (by the
+        dispatch eviction policy if it raised, or explicitly below if the
+        breaker was otherwise closed), while a recovered chip rejoins
+        serving with only the canary batch at risk."""
+        readmitted = 0
+        if self._mesh_has_evicted():
+            readmit = getattr(self.device, "mesh_readmit", None)
+            if readmit is not None:
+                try:
+                    readmitted = int(readmit() or 0)
+                except Exception:  # pragma: no cover
+                    readmitted = 0
         with self._lock:
-            if self._state == BREAKER_CLOSED:
+            was_closed = self._state == BREAKER_CLOSED
+            if was_closed and not readmitted:
                 return True
-            self._transition_locked(BREAKER_HALF_OPEN)
+            if not was_closed:
+                self._transition_locked(BREAKER_HALF_OPEN)
         ok = False
         err: Exception | None = None
         try:
             sets = self._build_canary_sets()
             with self._maybe_span("bls/canary_probe"):
                 ok = bool(
-                    self._dispatcher.run(
+                    self._device_call(
                         lambda: self.device.verify_signature_sets(sets),
-                        self.deadline_s,
+                        len(sets),
                     )
                 )
         except Exception as e:  # noqa: BLE001 — any failure keeps it open
@@ -388,12 +429,27 @@ class SupervisedBlsVerifier:
             if ok:
                 self._consecutive_failures = 0
                 self._transition_locked(BREAKER_CLOSED)
-            else:
+            elif not was_closed:
                 self._transition_locked(BREAKER_OPEN)
         if not ok:
+            if readmitted and was_closed and not self._mesh_has_evicted():
+                # restored full mesh failed the probe without attributing
+                # a chip: shrink again rather than leave production
+                # traffic on a sick full mesh
+                evict = getattr(self.device, "mesh_evict", None)
+                if evict is not None:
+                    try:
+                        evict(chip=None, reason="canary_failed")
+                    except Exception:  # pragma: no cover
+                        pass
             self._rl.warning(
-                "canary", "canary probe failed (%s); breaker stays open",
+                "canary", "canary probe failed (%s); device stays degraded",
                 err if err is not None else "device returned False",
+            )
+        elif readmitted:
+            self._log.info(
+                "canary probe passed; %d mesh chip(s) re-admitted",
+                readmitted,
             )
         else:
             self._log.info("canary probe passed; breaker closed")
@@ -417,8 +473,13 @@ class SupervisedBlsVerifier:
         while True:
             time.sleep(max(0.001, self.cooldown_s))
             with self._lock:
-                if self._closed or self._state == BREAKER_CLOSED:
+                if self._closed:
                     return
+                state = self._state
+            # the loop also outlives a closed breaker while mesh chips
+            # remain evicted: re-admission needs a canary too
+            if state == BREAKER_CLOSED and not self._mesh_has_evicted():
+                return
             try:
                 self.probe()
             except Exception:  # pragma: no cover — probe() already guards
@@ -445,11 +506,46 @@ class SupervisedBlsVerifier:
 
     # -- dispatch --------------------------------------------------------------
 
+    def _evict_sick_chip(self, exc, n_sets: int, reason: str) -> bool:
+        """Mesh half of the failure policy (round-7 tentpole): when the
+        device tier serves from a chip mesh, a failed dispatch evicts the
+        suspect chip — the one the exception attributes (`exc.chip`, e.g.
+        testing.faults.InjectedChipFault), else the dispatcher's default
+        — and the call retries immediately on the surviving mesh.
+        Eviction does NOT consume the transient-retry budget and does NOT
+        feed the breaker: a 3-chip node serving correctly is healthy, just
+        smaller. The canary thread re-admits once probes pass. Returns
+        True when a chip was evicted (caller should retry)."""
+        evict = getattr(self.device, "mesh_evict", None)
+        if evict is None:
+            return False
+        try:
+            new_size = evict(chip=getattr(exc, "chip", None), reason=reason)
+        except Exception:  # pragma: no cover — eviction must never mask
+            return False
+        if new_size is None:
+            return False
+        self._maybe_span_event(
+            "bls/mesh_eviction", reason=reason, new_size=new_size
+        )
+        self._rl.warning(
+            "mesh_evict",
+            "mesh chip evicted (%s); retrying %d sets on the surviving "
+            "%d-chip mesh", reason, n_sets, max(new_size, 1),
+        )
+        if self._canary_thread_enabled:
+            self._start_canary_thread()
+        return True
+
     def _device_call(self, fn, n_sets: int):
         """One supervised device call: deadline-bounded, one jittered
-        retry for raised errors. Raises on final failure."""
+        retry for raised errors, chip-eviction retries when the device
+        serves from a mesh (bounded by the mesh size — `mesh_evict`
+        returns None once nothing is left to evict). Raises on final
+        failure."""
         attempts = self._retry_policy.max_attempts
-        for attempt in range(attempts):
+        attempt = 0
+        while True:
             try:
                 return self._dispatcher.run(fn, self.deadline_s)
             except DeviceDeadlineExceeded:
@@ -460,9 +556,17 @@ class SupervisedBlsVerifier:
                     "worker abandoned",
                     n_sets, self.deadline_s,
                 )
+                # a wedged chip is a sick chip: shrink the mesh and retry
+                # on the survivors; without a mesh, deadline blowouts are
+                # never retried (a wedged kernel just burns a second one)
+                if self._evict_sick_chip(None, n_sets, "deadline"):
+                    continue
                 raise
             except Exception as e:
-                if attempt + 1 >= attempts:
+                if self._evict_sick_chip(e, n_sets, type(e).__name__):
+                    continue
+                attempt += 1
+                if attempt >= attempts:
                     raise
                 self.observer.supervisor_retry()
                 self._rl.warning(
@@ -470,7 +574,7 @@ class SupervisedBlsVerifier:
                     "device dispatch failed (%s: %s); retrying once with "
                     "backoff", type(e).__name__, e,
                 )
-                self._retry_policy.sleep(self._retry_policy.delay_s(attempt))
+                self._retry_policy.sleep(self._retry_policy.delay_s(attempt - 1))
 
     def _cpu_fallback(self, fn, reason: str, n_sets: int, default):
         """Serve from the CPU oracle; only a CPU failure on top of a

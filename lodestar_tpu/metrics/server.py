@@ -8,6 +8,10 @@ profiler + lifecycle-trace control surface:
                           (?slot=N &root=0x… &limit=K)
     GET /debug/breaker    device-supervisor circuit-breaker state +
                           failure-policy counters (chain/supervisor.py)
+    GET /debug/mesh       serving-mesh census: healthy/serving/evicted
+                          chips and compiled sharded verifiers
+                          (parallel/mesh.py); unmeshed nodes report
+                          wired: false
     GET /debug/faults     fault-injection plan (testing/faults.py);
                           ?set=<spec> arms it, ?clear=1 disarms — the
                           live chaos-drill control surface
@@ -39,6 +43,7 @@ class MetricsServer:
         profiler_stop=None,
         tracer=None,
         breaker=None,
+        mesh=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -127,6 +132,22 @@ class MetricsServer:
                         self._send_json(500, {"error": str(e)})
                         return
                     self._send_json(200, doc)
+                    return
+                if route == "/debug/mesh":
+                    # mesh = zero-arg callable returning the verifier's
+                    # mesh_snapshot(); single-device or CPU-only nodes
+                    # report wired: false (no mesh, kernels unsharded)
+                    snap = None
+                    if mesh is not None:
+                        try:
+                            snap = mesh()
+                        except Exception as e:
+                            self._send_json(500, {"error": str(e)})
+                            return
+                    if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
                     return
                 if route == "/debug/faults":
                     from ..testing import faults

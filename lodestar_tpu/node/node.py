@@ -179,6 +179,11 @@ class BeaconNode:
                     if self.bls_supervisor is not None
                     else None
                 ),
+                mesh=(
+                    self.bls_supervisor.mesh_snapshot
+                    if self.bls_supervisor is not None
+                    else None
+                ),
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)

@@ -226,6 +226,9 @@ class BenchEmitter:
         for rec in self.phases.values():
             if rec["status"] == "running":
                 rec["status"] = "killed"
+        # self-labelling marker: tools/bench_compare.py logs-and-skips a
+        # timed-out round instead of treating its partial rates as a trend
+        self.extra["timed_out"] = True
         self.extra["watchdog_fired_after_s"] = budget_s
         self.emit()
         os._exit(124)
@@ -239,6 +242,7 @@ class BenchEmitter:
                 for rec in self.phases.values():
                     if rec["status"] == "running":
                         rec["status"] = "killed"
+                self.extra["timed_out"] = True
                 self.emit()
                 if callable(prev):
                     prev(signum, frame)

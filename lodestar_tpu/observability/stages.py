@@ -224,6 +224,33 @@ class PipelineMetrics:
             "lodestar_bls_verifier_waiter_timeouts_total",
             "verify waiters that gave up after the flush-thread timeout",
         )
+        # mesh serving (round-7 tentpole): the grouped kernels dispatch
+        # onto a jax.sharding.Mesh when >1 chip is visible; these families
+        # let a dashboard tell a full 4-chip node from a degraded 3-chip
+        # one (size + evicted gauges move together on an eviction)
+        self.mesh_size = r.gauge(
+            "lodestar_bls_mesh_size",
+            "chips in the serving BLS dispatch mesh "
+            "(0 = unsharded single-device dispatch)",
+        )
+        self.mesh_evicted = r.gauge(
+            "lodestar_bls_mesh_evicted_devices",
+            "chips currently evicted from the serving mesh",
+        )
+        self.mesh_evictions = r.counter(
+            "lodestar_bls_mesh_evictions_total",
+            "chips evicted from the serving mesh, by failure reason",
+            label_names=("reason",),
+        )
+        self.mesh_readmissions = r.counter(
+            "lodestar_bls_mesh_readmissions_total",
+            "evicted chips re-admitted after a passing canary probe",
+        )
+        self.mesh_dispatches = r.counter(
+            "lodestar_bls_mesh_chip_dispatch_total",
+            "sharded kernel dispatches per participating chip",
+            label_names=("chip",),
+        )
         # device-busy sampler state: busy seconds accumulate per resolve,
         # the fraction is re-sampled over >=1 s wall windows
         self._busy_lock = threading.Lock()
@@ -294,6 +321,25 @@ class PipelineMetrics:
     def waiter_timeout(self) -> None:
         self.waiter_timeouts.inc()
 
+    # -- mesh serving -------------------------------------------------------
+
+    def mesh_state(self, size: int, evicted: int) -> None:
+        """Assert the current serving-mesh shape (size + evicted gauges)."""
+        self.mesh_size.set(size)
+        self.mesh_evicted.set(evicted)
+
+    def mesh_eviction(self, chip: int, reason: str) -> None:
+        self.mesh_evictions.inc(reason=reason)
+
+    def mesh_readmission(self, n: int = 1) -> None:
+        self.mesh_readmissions.inc(n)
+
+    def mesh_dispatch(self, chips) -> None:
+        """Tick the per-chip dispatch counter for every participating chip
+        of one sharded dispatch."""
+        for chip in chips:
+            self.mesh_dispatches.inc(chip=str(chip))
+
     # -- queue / flush ------------------------------------------------------
 
     def bind_buffer_depth(self, fn) -> None:
@@ -360,6 +406,25 @@ class PipelineMetrics:
             "decompress_fallbacks": int(self.decompress_fallbacks.value()),
         }
 
+    def mesh_snapshot(self) -> dict:
+        """Mesh-serving counters for the bench document and `/debug/mesh`:
+        current shape, eviction/re-admission history, per-chip dispatches."""
+        evictions = {
+            labels.get("reason", ""): int(v)
+            for labels, v in self.mesh_evictions.collect()
+        }
+        dispatches = {
+            labels.get("chip", ""): int(v)
+            for labels, v in self.mesh_dispatches.collect()
+        }
+        return {
+            "size": int(self.mesh_size.value()),
+            "evicted": int(self.mesh_evicted.value()),
+            "evictions": evictions,
+            "readmissions": int(self.mesh_readmissions.value()),
+            "chip_dispatches": dispatches,
+        }
+
     def supervisor_snapshot(self) -> dict:
         """Failure-policy counters for the bench document and
         `/debug/breaker`. `degraded` is the one-bit summary the bench
@@ -405,6 +470,9 @@ class PipelineMetrics:
             or snap["verdict_mismatches"]
             or fault_snap["active"]
             or fault_snap["injected"]
+            # a mesh currently missing chips serves real traffic but its
+            # throughput is not comparable to a full-mesh round
+            or int(self.mesh_evicted.value())
         )
         return snap
 

@@ -57,14 +57,24 @@ from ..ops.points import (
     g2,
     g2_psi,
 )
-from .verifier import HALF_BITS, _fp12_product_tree, _g2_sum_tree
+from .verifier import HALF_BITS, N_LIMBS, _fp12_product_tree, _g2_sum_tree
 
 __all__ = [
+    "mesh_divisor",
     "make_sharded_verifier",
     "ShardedBlsVerifier",
     "make_sharded_grouped_verifier",
     "ShardedGroupedVerifier",
+    "make_sharded_pk_grouped_verifier",
+    "ShardedPkGroupedVerifier",
+    "make_sharded_bisect_verifier",
+    "ShardedBisectVerifier",
 ]
+
+
+# host-side mesh sizing lives in the jax-free policy module; re-exported
+# here because every sharded-kernel consumer needs it for shape planning
+from .mesh import mesh_divisor  # noqa: E402  (after the jax imports above)
 
 
 def _local_body(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
@@ -262,6 +272,34 @@ def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
     return run
 
 
+def make_sharded_grouped_local_probe(mesh: Mesh, axis: str = "dp"):
+    """INSTRUMENTATION ONLY (tools/mesh_scaling.py): the sharded grouped
+    kernel cut after the per-chip local body — MSMs, Horner, the u-plane
+    all_gather and per-chip Miller lanes — with the root tail (cross-chip
+    Fp12 product + final exp) replaced by a psum checksum. Timing this
+    against the full kernel splits a scaling anomaly into "data-parallel
+    body" vs "sequential tail" without a profiler on the virtual mesh."""
+    ndev = mesh.devices.size
+    if (2 * HALF_BITS) % ndev != 0:
+        raise ValueError(
+            f"mesh size {ndev} must divide {2 * HALF_BITS} (constant lanes)"
+        )
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid):
+        def probe(*args):
+            f_loc = _grouped_local(axis, *args)
+            return lax.psum(jnp.sum(f_loc), axis)
+
+        fn = _shard_map(
+            probe, mesh=mesh, in_specs=(spec,) * 9, out_specs=P()
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid)
+
+    return run
+
+
 class ShardedGroupedVerifier:
     """Host wrapper for the sharded grouped kernel: places (R, L) grouped
     arrays root-sharded onto the mesh."""
@@ -273,15 +311,262 @@ class ShardedGroupedVerifier:
         self._run = make_sharded_grouped_verifier(mesh, axis)
         self._sharding = NamedSharding(mesh, P(axis))
 
-    def verify_grouped(self, g, a_bits, b_bits) -> bool:
+    def submit(self, g, a_bits, b_bits):
+        """Async dispatch: returns the on-device scalar verdict (the
+        production pipeline resolves it later, off the dispatch thread)."""
         put = lambda x: jax.device_put(x, self._sharding)
-        return bool(
-            self._run(
-                put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
-                put(g.sig_x), put(g.sig_y), put(a_bits), put(b_bits),
-                put(g.valid),
-            )
+        return self._run(
+            put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
+            put(g.sig_x), put(g.sig_y), put(a_bits), put(b_bits),
+            put(g.valid),
         )
+
+    def verify_grouped(self, g, a_bits, b_bits) -> bool:
+        return bool(self.submit(g, a_bits, b_bits))
+
+
+# --- pk-grouped (shared-pubkey) tier -----------------------------------------
+
+
+def _pk_grouped_local(
+    mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid
+):
+    """Per-chip slice of the PK-GROUPED batch equation (the dual kernel:
+    rows share a pubkey, messages MSM-combine on the twist).
+
+    The pubkey-row axis R is sharded: each chip owns R/n rows — their
+    per-row G2 message MSMs, Horner combines and (pk_k, Σ r_i·H_i) Miller
+    lanes are pure data parallelism. The signature aggregate and the 64
+    constant −[2^b]g1 lanes follow the same pattern as `_grouped_local`:
+    one `all_gather` of 64 partial G2 plane sums, constant lanes split
+    64/n per chip."""
+    r_loc, lanes = msg_x.shape[0], msg_x.shape[1]
+    n_loc = r_loc * lanes
+    ndev = (
+        lax.axis_size(mesh_axis)
+        if hasattr(lax, "axis_size")
+        else lax.psum(1, mesh_axis)
+    )
+
+    msgs = (msg_x, msg_y, fp2.one((r_loc, lanes)))
+    msgs = g2.select(valid, msgs, g2.infinity((r_loc, lanes)))
+    bits = jnp.concatenate([a_bits, b_bits], axis=-1)
+
+    m_planes = msm.masked_plane_sums(g2, msgs, bits)  # (64, r_loc)
+    tp = tuple(c.reshape((2, HALF_BITS) + c.shape[1:]) for c in m_planes)
+    tp = tuple(jnp.moveaxis(c, 1, 0) for c in tp)
+    ab = msm.horner_pow2(g2, tp)  # (2, r_loc)
+    a_pt = tuple(c[0] for c in ab)
+    b_pt = tuple(c[1] for c in ab)
+    q_row = g2.add(a_pt, g2_psi(b_pt))  # Σ r_i·H_i per local row
+
+    sig = (
+        sig_x.reshape((n_loc,) + sig_x.shape[-2:]),
+        sig_y.reshape((n_loc,) + sig_y.shape[-2:]),
+        fp2.one((n_loc,)),
+    )
+    sig = g2.select(valid.reshape(n_loc), sig, g2.infinity((n_loc,)))
+    u_part = msm.masked_plane_sums(g2, sig, bits.reshape(n_loc, 2 * HALF_BITS))
+    u_all = tuple(lax.all_gather(c, mesh_axis) for c in u_part)
+    u_all = tuple(jnp.moveaxis(c, 0, 1) for c in u_all)  # (64, ndev, …)
+    u_planes = msm.tree_sum(g2, u_all)
+    u_a = tuple(c[:HALF_BITS] for c in u_planes)
+    u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
+
+    per = (2 * HALF_BITS) // ndev
+    start = lax.axis_index(mesh_axis) * per
+    uq = tuple(jnp.concatenate([ca, cb], 0) for ca, cb in zip(u_a, u_b))
+    uq_loc = tuple(
+        lax.dynamic_slice_in_dim(c, start, per, axis=0) for c in uq
+    )
+    const_x = jnp.concatenate([NEG_G1_POW2_X, NEG_G1_POW2_X], 0)
+    const_y = jnp.concatenate([NEG_G1_POW2_Y, NEG_G1_POW2_Y], 0)
+    cx_loc = lax.dynamic_slice_in_dim(const_x, start, per, axis=0)
+    cy_loc = lax.dynamic_slice_in_dim(const_y, start, per, axis=0)
+
+    px = jnp.concatenate([pk_x, cx_loc], 0)
+    py = jnp.concatenate([pk_y, cy_loc], 0)
+    pz = jnp.concatenate([fp.one((r_loc,)), fp.one((per,))], 0)
+    qx = jnp.concatenate([q_row[0], uq_loc[0]], 0)
+    qy = jnp.concatenate([q_row[1], uq_loc[1]], 0)
+    qz = jnp.concatenate([q_row[2], uq_loc[2]], 0)
+
+    lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
+    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    fs = fp12.select(lane_ok, fs, fp12.one((r_loc + per,)))
+    return _fp12_product_tree(fs)
+
+
+def _sharded_pk_grouped_verify(mesh_axis, *args):
+    f_loc = _pk_grouped_local(mesh_axis, *args)
+    f_all = lax.all_gather(f_loc, mesh_axis)
+
+    def tail():
+        return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+
+    return _tail_on_root(mesh_axis, tail)
+
+
+def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str = "dp"):
+    """jit-compiled sharded pk-grouped batch-verify over `mesh`. The
+    pubkey-row axis must be divisible by the mesh size, and the mesh size
+    must divide 64 (the constant-lane count)."""
+    ndev = mesh.devices.size
+    if (2 * HALF_BITS) % ndev != 0:
+        raise ValueError(
+            f"mesh size {ndev} must divide {2 * HALF_BITS} (constant lanes)"
+        )
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid):
+        fn = _shard_map(
+            partial(_sharded_pk_grouped_verify, axis),
+            mesh=mesh,
+            in_specs=(spec,) * 9,
+            out_specs=P(),
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid)
+
+    return run
+
+
+class ShardedPkGroupedVerifier:
+    """Host wrapper for the sharded pk-grouped kernel: places (R,) pubkey
+    rows + (R, L) message/signature arrays row-sharded onto the mesh."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.devices.size
+        self._run = make_sharded_pk_grouped_verifier(mesh, axis)
+        self._sharding = NamedSharding(mesh, P(axis))
+
+    def submit(self, g, a_bits, b_bits):
+        put = lambda x: jax.device_put(x, self._sharding)
+        return self._run(
+            put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
+            put(g.sig_x), put(g.sig_y), put(a_bits), put(b_bits),
+            put(g.valid),
+        )
+
+    def verify_pk_grouped(self, g, a_bits, b_bits) -> bool:
+        return bool(self.submit(g, a_bits, b_bits))
+
+
+# --- bisection-verdict tier ---------------------------------------------------
+
+
+def _bisect_local(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
+    """Per-chip leaf terms of the bisection tree: each chip runs the
+    scalar ladders and both Miller lanes for its slice of the batch —
+    f_i = ML(r_i·pk_i, H_i)·ML(−g1, r_i·sig_i), identity for padding."""
+    n_loc = pk_x.shape[0]
+    rpk = g1.scalar_mul_bits(r_bits, (pk_x, pk_y))
+    rsig = g2.scalar_mul_bits(r_bits, (sig_x, sig_y))
+    neg_gy = fp.neg(G1_GEN_Y)
+    px = jnp.concatenate(
+        [rpk[0], jnp.broadcast_to(G1_GEN_X, (n_loc, N_LIMBS))], 0
+    )
+    py = jnp.concatenate(
+        [rpk[1], jnp.broadcast_to(neg_gy, (n_loc, N_LIMBS))], 0
+    )
+    pz = jnp.concatenate([rpk[2], fp.one((n_loc,))], 0)
+    qx = jnp.concatenate([msg_x, rsig[0]], 0)
+    qy = jnp.concatenate([msg_y, rsig[1]], 0)
+    qz = jnp.concatenate([fp2.one((n_loc,)), rsig[2]], 0)
+    fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
+    f = fp12.mul(fs[:n_loc], fs[n_loc:])
+    return fp12.select(valid, f, fp12.one((n_loc,)))
+
+
+def _sharded_bisect_verify(mesh_axis, *args):
+    f_loc = _bisect_local(*args)
+    # ICI: one Fp12 element per leaf per chip; the gather reconstructs the
+    # host's set order (shard k owns rows [k·n/ndev, (k+1)·n/ndev))
+    f_all = lax.all_gather(f_loc, mesh_axis)
+    leaves = f_all.reshape((-1,) + f_all.shape[2:])
+    n = leaves.shape[0]
+
+    # the product tree + root final exp are the latency-bound tail; run
+    # them on chip 0 only and psum-broadcast every internal level so the
+    # host bisection sees the same replicated `levels` the single-device
+    # kernel returns (round-4 virtual-mesh lesson: replicated tails burn
+    # every "chip"'s shared host core)
+    def tree(_):
+        levels = []
+        g_lvl = leaves
+        while g_lvl.shape[0] > 1:
+            g_lvl = fp12.mul(g_lvl[0::2], g_lvl[1::2])
+            levels.append(g_lvl)
+        root_ok = fp12.is_one(
+            final_exponentiation(levels[-1][0])
+        ).astype(jnp.int32)
+        return root_ok, tuple(levels)
+
+    def idle(_):
+        shapes = []
+        m = n
+        while m > 1:
+            m //= 2
+            shapes.append(m)
+        return jnp.int32(0), tuple(
+            jnp.zeros((m,) + leaves.shape[1:], leaves.dtype) for m in shapes
+        )
+
+    is_root = lax.axis_index(mesh_axis) == 0
+    root_int, upper = lax.cond(is_root, tree, idle, operand=None)
+    root_int = lax.psum(root_int, mesh_axis)
+    upper = tuple(lax.psum(u, mesh_axis) for u in upper)
+    return root_int > 0, (leaves,) + upper
+
+
+def make_sharded_bisect_verifier(mesh: Mesh, axis: str = "dp"):
+    """jit-compiled sharded bisection-tree kernel over `mesh`. The batch
+    size must be a power of two (the single-device kernel pads internally;
+    here the HOST must pad before sharding so slices stay uniform) and
+    divisible by the mesh size. Returns (root_ok, levels) with the same
+    level layout as `bisect_tree_kernel`."""
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
+        n = pk_x.shape[0]
+        if n & (n - 1):
+            raise ValueError(f"sharded bisect needs a power-of-two batch, got {n}")
+        out_specs = (P(), tuple(P() for _ in range(n.bit_length())))
+        fn = _shard_map(
+            partial(_sharded_bisect_verify, axis),
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=out_specs,
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid)
+
+    return run
+
+
+class ShardedBisectVerifier:
+    """Host wrapper for the sharded bisection-verdict kernel: places
+    padded per-set arrays lane-sharded onto the mesh. Batch size must be
+    a power of two divisible by the mesh size."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.devices.size
+        self._run = make_sharded_bisect_verifier(mesh, axis)
+        self._sharding = NamedSharding(mesh, P(axis))
+
+    def submit(self, arrs, r_bits):
+        put = lambda x: jax.device_put(x, self._sharding)
+        root_ok, levels = self._run(
+            put(arrs.pk_x), put(arrs.pk_y),
+            put(arrs.msg_x), put(arrs.msg_y),
+            put(arrs.sig_x), put(arrs.sig_y),
+            put(r_bits), put(arrs.valid),
+        )
+        return root_ok, list(levels)
 
 
 class ShardedBlsVerifier:

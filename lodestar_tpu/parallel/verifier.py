@@ -763,6 +763,7 @@ class TpuBlsVerifier:
         device_decompress: bool | None = None,
         pk_grouped_configs: tuple[tuple[int, int], ...] = ((128, 32),),
         observer=None,
+        mesh="auto",
     ):
         self.kernels = BatchVerifier(buckets, grouped_configs, pk_grouped_configs)
         # pipeline telemetry (observability.stages.PipelineMetrics): stage
@@ -819,6 +820,37 @@ class TpuBlsVerifier:
                 not in ("0", "off", "false")
             )
         self._device_decompress = bool(device_decompress)
+        # Mesh serving (round 7): grouped/pk-grouped/bisect batches
+        # dispatch across every visible chip via parallel/mesh. The
+        # default "auto" policy (env LODESTAR_TPU_MESH) enables the mesh
+        # only on real multi-chip hardware — virtual CPU meshes are
+        # opt-in ("force") because their chips share host cores. Pass a
+        # BlsMeshDispatcher for explicit control, or mesh=None to pin
+        # single-device dispatch.
+        if mesh == "auto":
+            from .mesh import auto_mesh
+
+            self._mesh = auto_mesh(self.observer)
+        else:
+            self._mesh = mesh or None
+
+    # -- mesh passthroughs (supervisor failure policy) ----------------------
+
+    def mesh_evict(self, chip: int | None = None, reason: str = "failure"):
+        """Evict a sick chip from the serving mesh; None when no mesh or
+        nothing left to evict (the supervisor then falls back tiers)."""
+        if self._mesh is None:
+            return None
+        return self._mesh.evict(chip=chip, reason=reason)
+
+    def mesh_readmit(self) -> int:
+        return 0 if self._mesh is None else self._mesh.readmit()
+
+    def mesh_has_evicted(self) -> bool:
+        return self._mesh is not None and self._mesh.has_evicted()
+
+    def mesh_snapshot(self):
+        return None if self._mesh is None else self._mesh.snapshot()
 
     # -- host marshalling ---------------------------------------------------
 
@@ -1105,11 +1137,30 @@ class TpuBlsVerifier:
         g.n = len(sets)
         return (g, sig_raw) if raw else g
 
+    def _submit_pk_grouped_mesh(self, sets, plan):
+        """Sharded pk-grouped dispatch (limb marshal — see
+        `_submit_grouped_mesh` for the raw-path tradeoff)."""
+        from .mesh import NOT_SHARDED
+
+        with self.observer.stage("marshal"):
+            g = self._marshal_pk_grouped(sets, plan)
+        if g is None:
+            return None
+        with self.observer.stage("rand"):
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        with self.observer.stage("dispatch"):
+            result = self._mesh.dispatch_pk_grouped(g, a_bits, b_bits)
+            if result is NOT_SHARDED:
+                result = self.kernels.verify_pk_grouped(g, a_bits, b_bits)
+        return result
+
     def _submit_pk_grouped(self, sets, plan):
         """Dispatch one pk-grouped batch; None marks an invalid set."""
         self.observer.planner(
             "pk_grouped", len(sets), group_sizes=[len(r) for r in plan[2]]
         )
+        if self._mesh_shardable(plan[0]):
+            return self._submit_pk_grouped_mesh(sets, plan)
         if self._device_decompress:
             with self.observer.stage("marshal"):
                 marshalled = self._marshal_pk_grouped(sets, plan, raw=True)
@@ -1284,12 +1335,42 @@ class TpuBlsVerifier:
         # modeling corrupted device computation
         return _faults.flaky_verdict(verdict)
 
+    def _mesh_shardable(self, rows: int) -> bool:
+        return (
+            self._mesh is not None
+            and self._mesh.enabled
+            and rows % self._mesh.size == 0
+        )
+
+    def _submit_grouped_mesh(self, sets, plan):
+        """Sharded grouped dispatch across the serving mesh. The mesh
+        path marshals LIMBS (C tier) rather than raw bytes: the sharded
+        kernels have no *_raw twins yet, and the pooled C-tier marshal
+        keeps the host cost bounded while every chip shares the pairing
+        work. Falls back to the single-device limb kernel if the mesh
+        shrank between the eligibility check and the dispatch."""
+        from .mesh import NOT_SHARDED
+
+        with self.observer.stage("marshal"):
+            g = self._marshal_grouped(sets, plan)
+        if g is None:
+            return None
+        with self.observer.stage("rand"):
+            a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+        with self.observer.stage("dispatch"):
+            result = self._mesh.dispatch_grouped(g, a_bits, b_bits)
+            if result is NOT_SHARDED:
+                result = self.kernels.verify_grouped(g, a_bits, b_bits)
+        return result
+
     def _submit_grouped(self, sets, plan):
         """Dispatch one grouped-kernel batch; None marks an invalid set
         (caller reports False)."""
         self.observer.planner(
             "root_grouped", len(sets), group_sizes=[len(r) for r in plan[2]]
         )
+        if self._mesh_shardable(plan[0]):
+            return self._submit_grouped_mesh(sets, plan)
         if self._device_decompress:
             with self.observer.stage("marshal"):
                 marshalled = self._marshal_grouped(sets, plan, raw=True)
@@ -1367,7 +1448,17 @@ class TpuBlsVerifier:
             r_bits = _rand_bits(arrs.pk_x.shape[0], self._rng)
         t = time.monotonic()
         with self.observer.stage("dispatch"):
-            root_ok, levels = self.kernels.verify_bisect_tree(arrs, r_bits)
+            sharded = None
+            if self._mesh is not None and self._mesh.enabled:
+                from .mesh import NOT_SHARDED
+
+                sharded = self._mesh.dispatch_bisect(arrs, r_bits)
+                if sharded is NOT_SHARDED:
+                    sharded = None
+            if sharded is not None:
+                root_ok, levels = sharded
+            else:
+                root_ok, levels = self.kernels.verify_bisect_tree(arrs, r_bits)
         with self.observer.stage("device_wait"):
             root_ok = bool(root_ok)
         self.observer.device_busy_sample(time.monotonic() - t)

@@ -23,6 +23,11 @@ Modes (comma-separated, each with an optional ``:param``):
     deadline[:seconds] sleep long (default 30 s) — a wedged dispatch
                        (cold compile, hung transfer); the supervisor's
                        watchdog must abandon it
+    chip[:index]       raise InjectedChipFault(index) on the next MESH
+                       dispatch, then disarm (ONE-SHOT) — a sick chip;
+                       the supervisor must evict it from the serving mesh
+                       and keep serving on the survivors (the eviction is
+                       visible in the lodestar_bls_mesh_* families)
     flaky[:rate]       corrupt verdicts: True -> False with probability
                        `rate` (default 1.0). One-directional by design:
                        random hardware corruption yields a pairing
@@ -49,11 +54,23 @@ class InjectedFault(RuntimeError):
     """Synthetic transient device failure (stands in for an XLA error)."""
 
 
+class InjectedChipFault(InjectedFault):
+    """Synthetic SINGLE-CHIP failure on a mesh dispatch: carries the sick
+    chip's index so the supervisor's eviction policy can attribute it.
+    Subclasses InjectedFault — handlers that only know the device-level
+    failure shape still catch it (and fall back to the CPU oracle)."""
+
+    def __init__(self, chip: int):
+        super().__init__(f"injected chip fault (chip {chip})")
+        self.chip = chip
+
+
 _MODE_DEFAULTS = {
     "exception": 1.0,   # probability
     "latency": 0.05,    # seconds
     "deadline": 30.0,   # seconds
     "flaky": 1.0,       # probability
+    "chip": 0.0,        # chip index (mesh dispatch; ONE-SHOT)
 }
 
 _lock = threading.Lock()
@@ -137,6 +154,24 @@ def on_device_dispatch(n_sets: int) -> None:
         raise InjectedFault(
             f"injected device fault (batch of {n_sets} sets)"
         )
+
+
+def on_mesh_dispatch(mesh_size: int) -> None:
+    """Called by the mesh dispatcher before every SHARDED dispatch. The
+    `chip[:index]` mode raises InjectedChipFault(chip) exactly ONCE and
+    then disarms itself — a sick chip is a persistent condition handled
+    by eviction, so after the supervisor evicts, subsequent dispatches on
+    the surviving mesh must succeed (the mid-run-eviction drill of
+    docs/robustness.md: serving continues on the remaining chips)."""
+    plan = _plan
+    if plan is None or "chip" not in plan:
+        return
+    with _lock:
+        if _plan is None or "chip" not in _plan:
+            return
+        chip = int(_plan.pop("chip"))
+        _injected["chip"] = _injected.get("chip", 0) + 1
+    raise InjectedChipFault(chip)
 
 
 def flaky_verdict(verdict: bool) -> bool:

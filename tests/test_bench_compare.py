@@ -204,3 +204,43 @@ def test_required_key_improvement_passes(tmp_path, capsys):
     })
     assert mod.main(["--dir", str(tmp_path)]) == 0
     capsys.readouterr()
+
+
+# --- timed-out partial flushes (round 7) -------------------------------------
+
+
+def test_timed_out_rounds_are_skipped_but_logged(tmp_path, capsys):
+    """A round the watchdog flushed mid-run (`timed_out: true`, round 7)
+    is parseable JSON with real-looking rows — but its rates stopped at
+    the deadline. Skippable-but-logged, in either direction: truncated
+    numbers gate nothing, and recovery from them is not a win."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    _round(tmp_path, 2, 1200.0, extra={  # "7.5x drop" — but partial
+        "timed_out": True, "watchdog_fired_after_s": 780.0,
+    })
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "timed out mid-run" in out and "nothing to gate" in out
+    _round(tmp_path, 3, 8800.0)  # completed again: compared against r01
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "r01 -> r03" in capsys.readouterr().out
+
+
+def test_timed_out_details_do_not_augment(tmp_path, capsys):
+    """A timed-out bench_details.json (SIGTERM flush) must not graft its
+    partial per-phase rows onto the latest completed round."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0,
+           extra={"e2e_wire_to_verdict_sets_per_sec": 2000.0})
+    _round(tmp_path, 2, 9000.0,
+           extra={"e2e_wire_to_verdict_sets_per_sec": 1900.0})
+    details = tmp_path / "bench_details.json"
+    details.write_text(json.dumps({
+        "metric": "bls_signature_sets_verified_per_sec",
+        "value": 9000.0,
+        "timed_out": True,
+        "e2e_wire_to_verdict_sets_per_sec": 300.0,  # partial-run rate
+    }))
+    assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 0
+    capsys.readouterr()

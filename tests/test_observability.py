@@ -299,6 +299,33 @@ def test_metrics_server_profiler_endpoints():
         server.close()
 
 
+def test_metrics_server_debug_mesh_endpoint():
+    """/debug/mesh serves the dispatcher snapshot when wired (round 7),
+    and reports wired:false when the node serves unmeshed."""
+    import urllib.request
+
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+
+    snap = {"size": 2, "healthy": [0, 1], "evicted": []}
+    server = MetricsServer(MetricsRegistry(), port=0, mesh=lambda: snap)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/mesh"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": True, **snap}
+    finally:
+        server.close()
+
+    server = MetricsServer(MetricsRegistry(), port=0, mesh=lambda: None)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/mesh"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": False}
+    finally:
+        server.close()
+
+
 # --- bench emitter -----------------------------------------------------------
 
 
@@ -379,6 +406,9 @@ def test_bench_emitter_sigterm_flush():
     assert doc["phases"]["spin"]["status"] == "killed"
     assert doc["value"] == 5.0  # partial results survive the kill
     assert doc["partial"] is True
+    # round 7: the kill is self-labelling so bench_compare can skip the
+    # truncated round instead of gating its rates
+    assert doc["timed_out"] is True
 
 
 def test_bench_emitter_watchdog_thread_emits_when_main_thread_is_stuck():
@@ -402,6 +432,7 @@ def test_bench_emitter_watchdog_thread_emits_when_main_thread_is_stuck():
     doc = json.loads(out.strip().splitlines()[-1])
     assert doc["phases"]["stuck"]["status"] == "killed"
     assert doc["watchdog_fired_after_s"] == 0.3
+    assert doc["timed_out"] is True
 
 
 def test_check_dashboards_lint_passes():

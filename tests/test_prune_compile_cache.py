@@ -1,0 +1,129 @@
+"""Unit tests for the compile-cache LRU pruner (tools/prune_compile_cache).
+
+Pure-filesystem policy tests: a fake cache directory with sized + aged
+files, no jax involvement. The pruner's contract is what warmup.py leans
+on every pass — oldest-first, bound respected, dry-run inert, missing
+dir a no-op — so these run in the default tier."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tools")
+)
+
+import prune_compile_cache as pcc  # noqa: E402
+
+MB = 1 << 20
+
+
+def _make(cache, name, size_mb, age):
+    """Write a `size_mb` file whose atime/mtime are `age` ticks old."""
+    path = os.path.join(cache, name)
+    with open(path, "wb") as f:
+        f.write(b"\0" * (size_mb * MB))
+    base = 1_700_000_000  # arbitrary fixed epoch keeps ordering explicit
+    os.utime(path, (base - age, base - age))
+    return path
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    d = tmp_path / "jax_cache"
+    d.mkdir()
+    return str(d)
+
+
+def test_scan_sorts_oldest_first(cache):
+    _make(cache, "newish", 1, age=10)
+    _make(cache, "oldest", 1, age=99)
+    _make(cache, "newest", 1, age=1)
+    names = [os.path.basename(p) for _, _, p in pcc.scan(cache)]
+    assert names == ["oldest", "newish", "newest"]
+
+
+def test_scan_missing_dir_is_empty(tmp_path):
+    assert pcc.scan(str(tmp_path / "nope")) == []
+
+
+def test_scan_recency_is_max_of_atime_mtime(cache):
+    # an old-mtime entry with a RECENT atime (cache hit on a noatime-free
+    # mount) must sort as recent, not as a prune candidate
+    hit = _make(cache, "hit", 1, age=99)
+    _make(cache, "cold", 1, age=50)
+    st = os.stat(hit)
+    os.utime(hit, (st.st_mtime + 98, st.st_mtime))  # touched atime only
+    names = [os.path.basename(p) for _, _, p in pcc.scan(cache)]
+    assert names == ["cold", "hit"]
+
+
+def test_prune_respects_bound_lru_order(cache):
+    _make(cache, "a_oldest", 4, age=40)
+    _make(cache, "b_middle", 4, age=30)
+    _make(cache, "c_recent", 4, age=20)
+    _make(cache, "d_newest", 4, age=10)
+    # 16 MB total, bound 10 MB: drop the two oldest (16->8 <= 10)
+    r = pcc.prune(cache, limit_gb=10 * MB / (1 << 30))
+    assert [os.path.basename(p) for p in r["removed"]] == [
+        "a_oldest", "b_middle",
+    ]
+    assert r["removed_bytes"] == 8 * MB
+    assert r["total_bytes"] == 8 * MB <= r["limit_bytes"]
+    assert sorted(os.listdir(cache)) == ["c_recent", "d_newest"]
+
+
+def test_prune_under_bound_is_noop(cache):
+    _make(cache, "only", 1, age=5)
+    r = pcc.prune(cache, limit_gb=1.0)
+    assert r["removed"] == [] and r["removed_bytes"] == 0
+    assert os.listdir(cache) == ["only"]
+
+
+def test_prune_dry_run_deletes_nothing(cache):
+    _make(cache, "a", 4, age=40)
+    _make(cache, "b", 4, age=10)
+    r = pcc.prune(cache, limit_gb=4 * MB / (1 << 30), dry_run=True)
+    assert [os.path.basename(p) for p in r["removed"]] == ["a"]
+    assert sorted(os.listdir(cache)) == ["a", "b"]
+
+
+def test_prune_missing_dir_is_noop(tmp_path):
+    r = pcc.prune(str(tmp_path / "nope"), limit_gb=0.0)
+    assert r == {
+        "entries": 0, "total_bytes": 0,
+        "limit_bytes": 0, "removed": [], "removed_bytes": 0,
+    }
+
+
+def test_prune_skips_subdirectories(cache):
+    # jax may namespace entries in subdirs; the pruner only bounds the
+    # flat entry files and must not crash on (or delete) directories
+    os.mkdir(os.path.join(cache, "subdir"))
+    _make(cache, "entry", 4, age=10)
+    r = pcc.prune(cache, limit_gb=1 * MB / (1 << 30))
+    assert [os.path.basename(p) for p in r["removed"]] == ["entry"]
+    assert os.listdir(cache) == ["subdir"]
+
+
+def test_default_limit_env_override(monkeypatch):
+    monkeypatch.delenv(pcc.ENV_LIMIT, raising=False)
+    assert pcc.default_limit_gb() == pcc.DEFAULT_LIMIT_GB
+    monkeypatch.setenv(pcc.ENV_LIMIT, "6.5")
+    assert pcc.default_limit_gb() == 6.5
+    monkeypatch.setenv(pcc.ENV_LIMIT, "banana")
+    assert pcc.default_limit_gb() == pcc.DEFAULT_LIMIT_GB
+
+
+def test_cli_dry_run(cache, capsys):
+    _make(cache, "a", 2, age=20)
+    _make(cache, "b", 2, age=10)
+    rc = pcc.main([
+        "--cache-dir", cache,
+        "--limit-gb", str(2 * MB / (1 << 30)),
+        "--dry-run",
+    ])
+    assert rc == 0
+    assert "would prune 1 entries" in capsys.readouterr().out
+    assert sorted(os.listdir(cache)) == ["a", "b"]

@@ -144,3 +144,66 @@ def test_sharded_grouped_refuses_non_dividing_mesh():
     mesh = Mesh(devices.reshape(6), axis_names=("dp",))
     with pytest.raises(ValueError, match="must divide"):
         make_sharded_grouped_verifier(mesh)
+
+
+def test_sharded_pk_grouped_parity_and_rejection(cpu_mesh):
+    """PK-grouped tier (round 7): 8 pubkey-rows × 4 messages over 8 chips
+    (1 row each); verdict parity with the single-device pk-grouped kernel
+    and rejection of a tampered lane."""
+    from lodestar_tpu.parallel.sharded import ShardedPkGroupedVerifier
+
+    host = TpuBlsVerifier(buckets=(16,), rng=_det_rng,
+                          pk_grouped_configs=((8, 4),))
+    sharded = ShardedPkGroupedVerifier(cpu_mesh)
+    # 8 signers × 4 distinct messages each → groups by pubkey
+    sets = []
+    for i in range(8):
+        sk = bls.interop_secret_key(i)
+        for j in range(4):
+            msg = bytes([0x10 * i + j]) * 32
+            sets.append(bls.SignatureSet(
+                pubkey=sk.to_public_key(),
+                message=msg,
+                signature=sk.sign(msg).to_bytes(),
+            ))
+    plan = host._plan_pk_groups(sets)
+    assert plan is not None
+    g = host._marshal_pk_grouped(sets, plan)
+    assert g is not None
+    a_bits, b_bits = _rand_pairs(g.valid.shape, _det_rng)
+    assert bool(host.kernels.verify_pk_grouped(g, a_bits, b_bits))
+    assert bool(sharded.submit(g, a_bits, b_bits)) is True
+
+    bad_sets = _tamper(sets, 13)
+    gb = host._marshal_pk_grouped(bad_sets, host._plan_pk_groups(bad_sets))
+    assert gb is not None
+    assert bool(host.kernels.verify_pk_grouped(gb, a_bits, b_bits)) is False
+    assert bool(sharded.submit(gb, a_bits, b_bits)) is False
+
+
+def test_sharded_bisect_parity_and_verdict_vector(cpu_mesh, host):
+    """Bisection tier (round 7): the sharded tree must hand back the SAME
+    root verdict and a `levels` pyramid the host bisection search walks
+    to the same per-set verdict vector as the single-device kernel."""
+    from lodestar_tpu.parallel.sharded import ShardedBisectVerifier
+
+    sharded = ShardedBisectVerifier(cpu_mesh)
+    sets = _make_sets(16)
+    arrs = host._marshal(sets)
+    assert arrs is not None
+    r_bits = _rand_bits(16, host._rng)
+
+    root_ref, _ = host.kernels.verify_bisect_tree(arrs, r_bits)
+    root_sh, _ = sharded.submit(arrs, r_bits)
+    assert bool(root_ref) is True and bool(root_sh) is True
+
+    # two invalid lanes on different chips: root fails both ways and the
+    # host bisection over the SHARDED levels finds exactly those lanes
+    bad = host._marshal(_tamper(_tamper(sets, 3), 12))
+    root_ref, lv_ref = host.kernels.verify_bisect_tree(bad, r_bits)
+    root_sh, lv_sh = sharded.submit(bad, r_bits)
+    assert bool(root_ref) is False and bool(root_sh) is False
+    v_ref = host._bisect(bad, lv_ref)
+    v_sh = host._bisect(bad, lv_sh)
+    assert list(v_sh[:16]) == list(v_ref[:16])
+    assert [i for i, ok in enumerate(v_sh[:16]) if not ok] == [3, 12]

@@ -14,8 +14,11 @@ regression tracking" as missing item #3 — this tool closes it.
 
 Comparison rules:
 - rounds whose document never parsed (`parsed: null` — a timed-out run)
-  carry no comparable rows and are skipped, exactly like the reference
-  skips benchmarks with no prior history;
+  carry no comparable rows and are skipped WITH a printed note, exactly
+  like the reference skips benchmarks with no prior history; rounds the
+  bench watchdog flushed partially (`timed_out: true`, round 7) parse
+  but are likewise logged-and-skipped — a truncated run's rates are not
+  a trend;
 - rounds that ran DEGRADED (`supervisor.degraded: true` in the bench
   document: CPU-oracle fallbacks, an open circuit breaker, or an armed
   fault-injection plan — round 7) are skipped with a printed note: a
@@ -54,6 +57,9 @@ DEFAULT_THRESHOLD = 3.0
 REQUIRED_GATED_KEYS = (
     "device_sets_per_sec_floor_distinct_pk_and_msg",
     "e2e_wire_to_verdict_sets_per_sec",
+    # the mesh-native serving rate (round-7 tentpole): the grouped kernel
+    # through the production mesh dispatcher on this host's mesh
+    "sharded_grouped_sets_per_sec",
 )
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
@@ -110,11 +116,31 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
         except (OSError, ValueError):
             continue
         parsed = rec.get("parsed") or {}
+        if not parsed:
+            # `parsed: null` — the harness died before emitting; the
+            # round carries no comparable rows but its absence from the
+            # gate must be visible, not silent
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — bench "
+                "document never parsed (parsed: null; harness killed "
+                "before emission)"
+            )
+            continue
         if _is_degraded(parsed):
             print(
                 f"bench_compare: skipping r{int(m.group(1)):02d} — ran "
                 "DEGRADED (CPU fallback / open breaker / faults armed); "
                 "not comparable to device-path rounds"
+            )
+            continue
+        if parsed.get("timed_out"):
+            # the watchdog/SIGTERM flushed a PARTIAL document before the
+            # driver's kill: parseable, but its rates stop mid-run — log
+            # and skip instead of gating a truncated round
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — timed "
+                "out mid-run (partial watchdog flush); rates not "
+                "comparable to completed rounds"
             )
             continue
         rows = _numeric_rows(parsed)
@@ -124,9 +150,12 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
     if rounds and details_path and os.path.exists(details_path):
         try:
             detail_doc = json.load(open(details_path))
-            detail_rows = (
-                {} if _is_degraded(detail_doc) else _numeric_rows(detail_doc)
-            )
+            if _is_degraded(detail_doc) or (
+                isinstance(detail_doc, dict) and detail_doc.get("timed_out")
+            ):
+                detail_rows = {}
+            else:
+                detail_rows = _numeric_rows(detail_doc)
         except (OSError, ValueError):
             detail_rows = {}
         # details belong to the newest run: augment without overriding

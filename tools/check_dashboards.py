@@ -51,6 +51,14 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_bls_supervisor_retries_total",
         "lodestar_bls_supervisor_both_tiers_failed_total",
         "lodestar_bls_verifier_waiter_timeouts_total",
+        # round-7 mesh-serving families (tentpole): a node serving on a
+        # shrunken mesh is healthy-but-slower — the eviction state must
+        # be on the dashboard, not only in /debug/mesh
+        "lodestar_bls_mesh_size",
+        "lodestar_bls_mesh_evicted_devices",
+        "lodestar_bls_mesh_evictions_total",
+        "lodestar_bls_mesh_readmissions_total",
+        "lodestar_bls_mesh_chip_dispatch_total",
     ),
 }
 

@@ -1,0 +1,131 @@
+"""Size-bounded LRU GC for the persistent XLA compile cache.
+
+The `.jax_cache` directory only ever grows: every kernel revision, bench
+shape and mesh size leaves its executables behind (the sharded grouped
+kernel alone serializes ~7 MB per shape, and a round of warmup + bench +
+mesh-scaling probes writes dozens of entries). Entries are independent
+files — deleting one costs exactly one recompile of that kernel — so the
+right policy is plain LRU by file age with a size bound, the same shape
+as the reference's worker-pool keeping `poolSize` bounded rather than
+unbounded.
+
+    python tools/prune_compile_cache.py                # bound to 2 GiB
+    python tools/prune_compile_cache.py --limit-gb 6   # custom bound
+    python tools/prune_compile_cache.py --dry-run      # report only
+
+`tools/warmup.py` invokes `prune(...)` automatically at the end of every
+warm-up pass (LODESTAR_TPU_CACHE_LIMIT_GB overrides the 2 GiB default),
+so the steady-state workflow — warm, bench, repeat — self-bounds instead
+of filling the disk. Recency is `max(atime, mtime)`: atime tracks cache
+HITS where the filesystem records it (an entry the node loads every
+restart stays), mtime is the portable fallback on noatime mounts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+DEFAULT_LIMIT_GB = 2.0
+ENV_LIMIT = "LODESTAR_TPU_CACHE_LIMIT_GB"
+DEFAULT_CACHE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache")
+)
+
+
+def default_limit_gb() -> float:
+    """The configured bound: LODESTAR_TPU_CACHE_LIMIT_GB, else 2 GiB."""
+    raw = os.environ.get(ENV_LIMIT)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            print(
+                f"prune_compile_cache: ignoring bad {ENV_LIMIT}={raw!r}",
+                file=sys.stderr,
+            )
+    return DEFAULT_LIMIT_GB
+
+
+def scan(cache_dir: str) -> list[tuple[float, int, str]]:
+    """[(recency, size, path)] for every regular file in the cache —
+    oldest first. Missing directory scans as empty (a fresh checkout has
+    no cache yet; pruning it is a no-op, not an error)."""
+    entries = []
+    try:
+        names = os.listdir(cache_dir)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        path = os.path.join(cache_dir, name)
+        if not os.path.isfile(path):
+            continue
+        st = os.stat(path)
+        entries.append((max(st.st_atime, st.st_mtime), st.st_size, path))
+    entries.sort()
+    return entries
+
+
+def prune(
+    cache_dir: str = DEFAULT_CACHE_DIR,
+    limit_gb: float | None = None,
+    dry_run: bool = False,
+) -> dict:
+    """Delete least-recently-used entries until the cache fits the bound.
+
+    Returns {"entries", "total_bytes", "limit_bytes", "removed",
+    "removed_bytes"} — `removed` lists the pruned paths (would-be-pruned
+    under `dry_run`)."""
+    if limit_gb is None:
+        limit_gb = default_limit_gb()
+    entries = scan(cache_dir)
+    total = sum(size for _, size, _ in entries)
+    limit = int(limit_gb * (1 << 30))
+    removed: list[str] = []
+    removed_bytes = 0
+    if total > limit:
+        for _, size, path in entries:
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    continue  # concurrent writer already replaced it
+            removed.append(path)
+            removed_bytes += size
+            total -= size
+            if total <= limit:
+                break
+    return {
+        "entries": len(entries),
+        "total_bytes": total,
+        "limit_bytes": limit,
+        "removed": removed,
+        "removed_bytes": removed_bytes,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cache-dir", default=DEFAULT_CACHE_DIR,
+                    help="compile-cache directory (default: repo .jax_cache)")
+    ap.add_argument("--limit-gb", type=float, default=None,
+                    help=f"size bound in GiB (default: ${ENV_LIMIT} or "
+                         f"{DEFAULT_LIMIT_GB})")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="report what would be pruned without deleting")
+    args = ap.parse_args(argv)
+    limit_gb = args.limit_gb if args.limit_gb is not None else default_limit_gb()
+    result = prune(args.cache_dir, limit_gb, dry_run=args.dry_run)
+    verb = "would prune" if args.dry_run else "pruned"
+    print(
+        f"cache {args.cache_dir}: {result['entries']} entries, "
+        f"bound {limit_gb} GiB; {verb} {len(result['removed'])} "
+        f"entries ({result['removed_bytes'] / (1 << 30):.2f} GiB) -> "
+        f"{result['total_bytes'] / (1 << 30):.2f} GiB"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

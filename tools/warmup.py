@@ -12,6 +12,10 @@ makes warm-up an explicit, documented step:
                                          #   LAST kernel change of a round)
   python tools/warmup.py --prune-gb 6    # GC the cache down to 6 GiB (LRU)
 
+Every warm-up pass ends with an automatic LRU GC of the cache (bound:
+LODESTAR_TPU_CACHE_LIMIT_GB, default 2 GiB) — the policy lives in
+tools/prune_compile_cache.py, which is also a standalone CLI.
+
 The production ladder = every shape the buffered verifier can dispatch
 steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
 (16x8, 64x64) + the pk-grouped config (128x32 — the adversarial
@@ -44,33 +48,19 @@ CACHE_DIR = os.path.abspath(
 )
 
 
-def prune_cache(limit_gb: float) -> None:
-    """Delete least-recently-used cache entries until the cache fits the
-    bound. XLA cache entries are independent files — deleting one only
-    costs a recompile of that one kernel."""
-    entries = []
-    total = 0
-    for name in os.listdir(CACHE_DIR):
-        path = os.path.join(CACHE_DIR, name)
-        if not os.path.isfile(path):
-            continue
-        st = os.stat(path)
-        # atime tracks cache hits where the fs records it; fall back on mtime
-        entries.append((max(st.st_atime, st.st_mtime), st.st_size, path))
-        total += st.st_size
-    limit = int(limit_gb * (1 << 30))
-    print(f"cache: {len(entries)} entries, {total / (1 << 30):.2f} GiB "
-          f"(bound {limit_gb} GiB)")
-    if total <= limit:
-        return
-    removed = 0
-    for _, size, path in sorted(entries):
-        os.unlink(path)
-        total -= size
-        removed += 1
-        if total <= limit:
-            break
-    print(f"pruned {removed} entries -> {total / (1 << 30):.2f} GiB")
+def prune_cache(limit_gb: float | None = None) -> None:
+    """LRU-GC the cache to the bound (tools/prune_compile_cache.py owns
+    the policy; default bound 2 GiB, LODESTAR_TPU_CACHE_LIMIT_GB
+    overrides). XLA cache entries are independent files — deleting one
+    only costs a recompile of that one kernel."""
+    import prune_compile_cache
+
+    if limit_gb is None:
+        limit_gb = prune_compile_cache.default_limit_gb()
+    result = prune_compile_cache.prune(CACHE_DIR, limit_gb)
+    print(f"cache: {result['entries']} entries (bound {limit_gb} GiB); "
+          f"pruned {len(result['removed'])} -> "
+          f"{result['total_bytes'] / (1 << 30):.2f} GiB")
 
 
 def warm_production(include_bench: bool, device_decompress: bool = True) -> None:
@@ -202,6 +192,7 @@ def main() -> None:
         return
     if args.dryrun:
         warm_dryrun(args.devices)
+        prune_cache()  # self-bounding: every warm-up pass ends with GC
         return
     # mirror the runtime default: raw kernels ON unless explicitly off
     # (an explicit --device-decompress wins over the env off-switch)
@@ -212,6 +203,7 @@ def main() -> None:
         args.no_device_decompress or env_off
     )
     warm_production(args.bench, device_decompress=device_decompress)
+    prune_cache()  # self-bounding: every warm-up pass ends with GC
 
 
 if __name__ == "__main__":
