@@ -253,7 +253,6 @@ def _bench_adversarial_mix(jax) -> float | None:
     outside the timed region)."""
     from lodestar_tpu.parallel.verifier import (
         TpuBlsVerifier,
-        _rand_bits,
         _rand_pairs,
     )
     from lodestar_tpu import native
@@ -488,17 +487,16 @@ def main() -> None:
 
     from lodestar_tpu.observability import BenchEmitter
     from lodestar_tpu.observability.stages import default_pipeline
+    from lodestar_tpu.utils.env import env_float
 
     # per-phase budget: SIGALRM raises inside the phase at the deadline,
     # which is recorded as `status: timeout` and skipped — later phases
     # still run, and the final JSON always prints (emitter atexit/SIGTERM)
-    deadline = float(os.environ.get("LODESTAR_TPU_BENCH_PHASE_DEADLINE", "600"))
+    deadline = env_float("LODESTAR_TPU_BENCH_PHASE_DEADLINE")
     # the watchdog THREAD emits + exits even when the main thread is stuck
     # in a C call (XLA compile) that SIGALRM/SIGTERM cannot interrupt; set
     # it below the driver's global timeout
-    global_deadline = float(
-        os.environ.get("LODESTAR_TPU_BENCH_GLOBAL_DEADLINE", "840")
-    )
+    global_deadline = env_float("LODESTAR_TPU_BENCH_GLOBAL_DEADLINE")
     pipeline = default_pipeline()
     em = BenchEmitter(
         "bls_signature_sets_verified_per_sec",

@@ -7,7 +7,6 @@ cross the boundary via to_obj/from_obj (the reference's json types).
 
 from __future__ import annotations
 
-from ..state_transition import util as st_util
 
 
 class ApiError(Exception):

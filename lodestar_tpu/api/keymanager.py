@@ -8,7 +8,6 @@ process, guarded by a bearer token in the reference (token optional here).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 
 from ..validator.keystore import KeystoreError, decrypt_keystore
 from .impl import ApiError

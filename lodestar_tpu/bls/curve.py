@@ -10,9 +10,9 @@ E2: y² = x³ + 4(1+u)   over Fq2   (M-twist with ξ = 1+u)
 
 from __future__ import annotations
 
-from typing import Generic, TypeVar, Union
+from typing import Generic, TypeVar
 
-from .fields import P, R, X_PARAM, Fq, Fq2, Fq6, Fq12, XI
+from .fields import P, R, X_PARAM, Fq, Fq2, XI
 
 F = TypeVar("F", Fq, Fq2)
 

@@ -107,16 +107,15 @@ class DeviceBlsVerifier:
         grouped_configs: tuple[tuple[int, int], ...] = ((16, 8), (64, 64)),
         observer=None,
     ):
-        import os
-
         from ..parallel.verifier import TpuBlsVerifier
+        from ..utils.env import env_str
 
         self._inner = TpuBlsVerifier(
             buckets=buckets, grouped_configs=grouped_configs, observer=observer
         )
         self.observer = self._inner.observer
         self.max_sets_per_job = buckets[-1]
-        self._profile_dir = os.environ.get("LODESTAR_TPU_PROFILE")
+        self._profile_dir = env_str("LODESTAR_TPU_PROFILE")
         self._last_fallback_log = float("-inf")
 
     def _annotate(self, label: str):
@@ -337,10 +336,10 @@ class ThreadBufferedVerifier:
     def __init__(self, verifier: IBlsVerifier, max_sigs: int = MAX_BUFFERED_SIGS,
                  max_wait_ms: float = MAX_BUFFER_WAIT_MS, prom=None,
                  pipeline=None, waiter_timeout_s: float | None = None):
-        import os
         import threading
 
         from ..observability.stages import default_pipeline
+        from ..utils.env import env_float
 
         self.verifier = verifier
         self.max_sigs = max_sigs
@@ -351,14 +350,12 @@ class ThreadBufferedVerifier:
         # supervisor's per-dispatch deadline fires far earlier; this is
         # the last-resort escalation path.
         if waiter_timeout_s is None:
-            waiter_timeout_s = float(
-                os.environ.get("LODESTAR_TPU_WAITER_TIMEOUT", "300")
-            )
+            waiter_timeout_s = env_float("LODESTAR_TPU_WAITER_TIMEOUT")
         self.waiter_timeout = waiter_timeout_s
         self.prom = prom
         self._lock = threading.Lock()
-        self._entries: list[tuple[list, object, list]] = []
-        self._timer: object | None = None
+        self._entries: list[tuple[list, object, list]] = []  # guarded-by: _lock
+        self._timer: object | None = None  # guarded-by: _lock
         self.metrics = {"batches": 0, "sigs_verified": 0, "batch_fallbacks": 0}
         # pipeline telemetry: flush-reason counter, flush latency, and the
         # LIVE buffer-depth gauge (collection-time callback — no polling)

@@ -12,21 +12,20 @@ verification over all sets of the segment (through the pluggable verifier
 
 from __future__ import annotations
 
+import logging
 import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
-from ..bls import api as bls
-from ..config.beacon_config import compute_signing_root
-from ..params import DOMAIN_BEACON_ATTESTER
 from ..state_transition import CachedBeaconState, process_slots
-from ..state_transition.block import BlockProcessingError, get_attesting_indices
-from ..state_transition.epoch import _get_block_root
+from ..state_transition.block import get_attesting_indices
 from ..state_transition.signature_sets import get_block_signature_sets
 from ..state_transition.stf import state_transition
 from ..state_transition import util as st_util
 from ..fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
 from ..observability import spans as _spans
+from ..utils.env import env_float
 from .bls_verifier import CpuBlsVerifier, IBlsVerifier
 from .clock import BeaconClock, ManualClock
 from .op_pools import (
@@ -47,6 +46,7 @@ from .seen_cache import (
 )
 from .state_cache import CheckpointStateCache, StateContextCache
 
+_log = logging.getLogger(__name__)
 
 
 def _verify_now(verifier, sets) -> bool:
@@ -90,6 +90,34 @@ _VERIFY_NOW_SUPPORT: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 class BlockImportError(ValueError):
     pass
+
+
+def _bounded_result(fut, site: str, m=None):
+    """``fut.result()`` bounded by LODESTAR_TPU_IMPORT_WAIT_TIMEOUT.
+
+    Block/segment import must never pin the serving thread forever on a
+    wedged future (a hung EL socket, a dead device worker): the wait is
+    bounded (<= 0 disables the bound), and a timeout increments
+    ``lodestar_chain_blocking_wait_timeouts_total{site=...}`` before
+    failing the import with a clear error instead of hanging silently.
+    """
+    timeout = env_float("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT")
+    if timeout is not None and timeout <= 0:
+        timeout = None
+    try:
+        return fut.result(timeout=timeout)
+    except FuturesTimeout:
+        if m is not None:
+            m.blocking_wait_timeouts_total.inc(site=site)
+        _log.error(
+            "blocking wait at %s exceeded LODESTAR_TPU_IMPORT_WAIT_TIMEOUT "
+            "(%.1fs) — escalating instead of hanging the import path",
+            site, timeout,
+        )
+        raise BlockImportError(
+            f"{site} wait exceeded LODESTAR_TPU_IMPORT_WAIT_TIMEOUT "
+            f"({timeout:.1f}s); the verification backend may be wedged"
+        ) from None
 
 
 class BeaconChain:
@@ -299,7 +327,9 @@ class BeaconChain:
             t_stf = _time.monotonic()
             if m is not None:
                 m.block_stf_seconds.observe(t_stf - t_start)
-            if fut_sig is not None and not fut_sig.result():
+            if fut_sig is not None and not _bounded_result(
+                fut_sig, "block_signature", m
+            ):
                 if m is not None:
                     m.block_import_errors_total.inc(reason="signature")
                 raise BlockImportError("block signature set verification failed")
@@ -309,7 +339,8 @@ class BeaconChain:
             if m is not None and fut_sig is not None:
                 # wait beyond the STF, i.e. the non-overlapped signature tail
                 m.block_sig_seconds.observe(t_sig - t_stf)
-            payload_status = fut_payload.result()  # raises on INVALID
+            # raises on INVALID; bounded so a hung EL can't wedge imports
+            payload_status = _bounded_result(fut_payload, "block_payload", m)
             if m is not None:
                 m.block_payload_seconds.observe(_time.monotonic() - t_sig)
                 m.block_import_seconds.observe(_time.monotonic() - t_start)
@@ -321,9 +352,9 @@ class BeaconChain:
             for fut in (fut_sig, fut_payload):
                 if fut is not None:
                     try:
-                        fut.result()
-                    except Exception:
-                        pass
+                        _bounded_result(fut, "block_drain", m)
+                    except Exception as drained:
+                        _log.debug("drained parallel import future: %s", drained)
             raise
 
         self._import_block(signed_block, block_root, post, payload_status)
@@ -467,8 +498,9 @@ class BeaconChain:
                                     f" (invalid signature in block(s) at "
                                     f"slot(s) {bad_slots})"
                                 )
-                        except Exception:
-                            pass  # pinpointing is best-effort diagnostics
+                        except Exception as e:
+                            # pinpointing is best-effort diagnostics
+                            _log.debug("bisect pinpointing failed: %s", e)
                     raise BlockImportError(
                         "segment signature batch failed" + detail
                     )
@@ -481,24 +513,28 @@ class BeaconChain:
         except BaseException:
             for _, _, _, fut in pending:
                 try:
-                    fut.result()
-                except Exception:
-                    pass
+                    _bounded_result(fut, "segment_drain", m)
+                except Exception as drained:
+                    _log.debug("drained segment payload future: %s", drained)
             raise
 
         roots = []
         for signed, root, post, fut_payload in pending:
             try:
-                payload_status = fut_payload.result()
+                payload_status = _bounded_result(
+                    fut_payload, "segment_payload", m
+                )
             except BaseException:
                 if m is not None:
                     m.block_import_errors_total.inc(reason="payload")
                 for _, _, _, f in pending:
                     if not f.done():
                         try:
-                            f.result()
-                        except Exception:
-                            pass
+                            _bounded_result(f, "segment_drain", m)
+                        except Exception as drained:
+                            _log.debug(
+                                "drained segment payload future: %s", drained
+                            )
                 raise
             t0 = _time.monotonic()
             self._import_block(signed, root, post, payload_status)
@@ -681,7 +717,10 @@ class BeaconChain:
                             head_correct=head_at_slot
                             == bytes(att.data.beacon_block_root),
                         )
-                except Exception:
+                except Exception as e:
+                    _log.debug(
+                        "validator-monitor inclusion accounting failed: %s", e
+                    )
                     continue
         if monitored:
             epoch = int(block.slot) // self.preset.SLOTS_PER_EPOCH
@@ -713,8 +752,9 @@ class BeaconChain:
                         signed_block, parent_block, parent_state
                     )
                     self._emit_light_client_updates()
-                except Exception:
-                    pass  # light-client data is best-effort, never blocks import
+                except Exception as e:
+                    # light-client data is best-effort, never blocks import
+                    _log.debug("light-client server on_import_block failed: %s", e)
         self.blocks[block_root] = signed_block
         self.db.block.put(block_root, signed_block)
         self.state_cache.add(state.hash_tree_root(), post, block_root=block_root)
@@ -843,8 +883,9 @@ class BeaconChain:
         )
         try:
             self.execution_engine.notify_forkchoice_update(head_hash, head_hash, fin_hash)
-        except Exception:
-            pass  # EL sync is advisory for the beacon side
+        except Exception as e:
+            # EL sync is advisory for the beacon side
+            _log.debug("forkchoiceUpdated notification failed: %s", e)
 
     # -- attestation intake (gossip path) ------------------------------------
 
@@ -867,8 +908,8 @@ class BeaconChain:
                     monitor.on_gossip_attestation(
                         int(attestation.data.target.epoch), int(idx), delay
                     )
-            except Exception:
-                pass
+            except Exception as e:
+                _log.debug("validator-monitor gossip accounting failed: %s", e)
 
     def on_aggregated_attestation(self, attestation, data_root: bytes) -> None:
         with self.import_lock:
@@ -892,8 +933,10 @@ class BeaconChain:
                 monitor.on_attestation_in_aggregate(
                     int(attestation.data.target.epoch), indices
                 )
-        except Exception:
-            pass
+        except Exception as e:
+            # aggregate fork-choice accounting is advisory; the pool add
+            # above already succeeded
+            _log.debug("aggregated-attestation accounting failed: %s", e)
 
     # -- block production (chain/produceBlock) -------------------------------
 

@@ -14,6 +14,10 @@ from __future__ import annotations
 import threading
 from enum import Enum
 
+from ..utils.logger import get_logger
+
+log = get_logger("chain-emitter")
+
 
 class ChainEvent(str, Enum):
     # reference eventstream topic names (routes/events.ts)
@@ -48,4 +52,5 @@ class ChainEventEmitter:
             try:
                 cb(event, payload)
             except Exception:
-                pass  # a bad subscriber must not break block import
+                # a bad subscriber must not break block import
+                log.warning("subscriber failed for %s", event, exc_info=True)

@@ -11,6 +11,9 @@ from __future__ import annotations
 
 from ..state_transition import process_slots
 from ..state_transition.stf import fork_types
+from ..utils.logger import get_logger
+
+log = get_logger("prepare-next-slot")
 
 
 class PrepareNextSlotScheduler:
@@ -70,5 +73,7 @@ class PrepareNextSlotScheduler:
             chain.execution_engine.notify_forkchoice_update(
                 parent_hash, parent_hash, parent_hash, attributes
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # early payload-building is advisory; block production falls
+            # back to a late forkchoiceUpdated
+            log.debug("early forkchoiceUpdated failed: %s", e)

@@ -59,12 +59,11 @@ drill runbook.
 
 from __future__ import annotations
 
-import logging
-import os
 import queue
 import threading
 import time
 
+from ..utils.env import env_bool, env_float
 from ..utils.logger import RateLimitedLogger, get_logger
 from ..utils.retry import RetryPolicy
 from .bls_verifier import CpuBlsVerifier
@@ -78,21 +77,10 @@ BREAKER_STATE_VALUES = {
     BREAKER_OPEN: 2,
 }
 
-DEFAULT_DEVICE_DEADLINE_S = 120.0
-DEFAULT_BREAKER_THRESHOLD = 3
-DEFAULT_BREAKER_COOLDOWN_S = 30.0
-DEFAULT_DEVICE_RETRIES = 1
-
-
 class DeviceDeadlineExceeded(RuntimeError):
     """A device dispatch outlived its watchdog deadline."""
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class _DeadlineDispatcher:
@@ -230,35 +218,25 @@ class SupervisedBlsVerifier:
         self.deadline_s = (
             deadline_s
             if deadline_s is not None
-            else _env_float(
-                "LODESTAR_TPU_DEVICE_DEADLINE", DEFAULT_DEVICE_DEADLINE_S
-            )
+            else env_float("LODESTAR_TPU_DEVICE_DEADLINE")
         )
         self.failure_threshold = int(
             failure_threshold
             if failure_threshold is not None
-            else _env_float(
-                "LODESTAR_TPU_BREAKER_THRESHOLD", DEFAULT_BREAKER_THRESHOLD
-            )
+            else env_float("LODESTAR_TPU_BREAKER_THRESHOLD")
         )
         self.cooldown_s = (
             cooldown_s
             if cooldown_s is not None
-            else _env_float(
-                "LODESTAR_TPU_BREAKER_COOLDOWN", DEFAULT_BREAKER_COOLDOWN_S
-            )
+            else env_float("LODESTAR_TPU_BREAKER_COOLDOWN")
         )
         retries = (
             retries
             if retries is not None
-            else int(
-                _env_float("LODESTAR_TPU_DEVICE_RETRIES", DEFAULT_DEVICE_RETRIES)
-            )
+            else int(env_float("LODESTAR_TPU_DEVICE_RETRIES"))
         )
         if audit_negative is None:
-            audit_negative = os.environ.get(
-                "LODESTAR_TPU_AUDIT_NEGATIVE", "1"
-            ).lower() not in ("0", "off", "false")
+            audit_negative = env_bool("LODESTAR_TPU_AUDIT_NEGATIVE")
         self.audit_negative = bool(audit_negative)
         # deadline blowouts are never retried (a wedged kernel just burns
         # a second deadline); raised errors get `retries` extra attempts
@@ -272,13 +250,13 @@ class SupervisedBlsVerifier:
         self._dispatcher = _DeadlineDispatcher()
         self._time = time_fn
         self._lock = threading.Lock()
-        self._state = BREAKER_CLOSED
-        self._consecutive_failures = 0
-        self._opened_at: float | None = None
+        self._state = BREAKER_CLOSED  # guarded-by: _lock
+        self._consecutive_failures = 0  # guarded-by: _lock
+        self._opened_at: float | None = None  # guarded-by: _lock
         self._canary_thread_enabled = bool(canary_thread)
-        self._canary_thread: threading.Thread | None = None
+        self._canary_thread: threading.Thread | None = None  # guarded-by: _lock
         self._canary_sets = canary_sets
-        self._closed = False
+        self._closed = False  # guarded-by: _lock
         self._log = get_logger("bls-supervisor")
         self._rl = RateLimitedLogger(self._log, interval_s=30.0)
         self.observer.breaker_state(BREAKER_STATE_VALUES[self._state])
@@ -441,7 +419,10 @@ class SupervisedBlsVerifier:
                     try:
                         evict(chip=None, reason="canary_failed")
                     except Exception:  # pragma: no cover
-                        pass
+                        self._log.debug(
+                            "mesh_evict after failed canary errored",
+                            exc_info=True,
+                        )
             self._rl.warning(
                 "canary", "canary probe failed (%s); device stays degraded",
                 err if err is not None else "device returned False",

@@ -7,7 +7,6 @@ import blocks every (accelerated) slot, expose the REST API and metrics.
 
 from __future__ import annotations
 
-import argparse
 import time
 
 from ..api import BeaconApiServer

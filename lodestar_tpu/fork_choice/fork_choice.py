@@ -11,12 +11,12 @@ validator (current root index, next root index, last-update epoch) so
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 from ..params.constants import INTERVALS_PER_SLOT
-from .proto_array import ProtoArray, ProtoArrayError
+from .proto_array import ProtoArray
 
 NO_VOTE = -1
 

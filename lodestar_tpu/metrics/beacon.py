@@ -160,6 +160,11 @@ def create_beacon_metrics(registry: MetricsRegistry | None = None):
         "lodestar_block_processor_errors_total", "failed imports by reason",
         label_names=("reason",),
     )
+    m.blocking_wait_timeouts_total = r.counter(
+        "lodestar_chain_blocking_wait_timeouts_total",
+        "serving-path future waits that hit LODESTAR_TPU_IMPORT_WAIT_TIMEOUT",
+        label_names=("site",),
+    )
 
     # --- regen / caches (reference regen.* stateCache.*) ----------------
     m.regen_replays_total = r.counter(

@@ -4,7 +4,6 @@ registry rendering the text exposition format — the prom-client role."""
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:

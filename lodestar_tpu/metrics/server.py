@@ -157,7 +157,15 @@ class MetricsServer:
                         if "set" in q:
                             doc = faults.configure(q["set"][0])
                         elif "clear" in q:
-                            faults.clear()
+                            # ?clear=1&reset_counters=1 also zeroes the
+                            # injection counters (drill teardown); a bare
+                            # clear keeps them so a degraded run stays
+                            # self-labelled
+                            reset = q.get("reset_counters", ["0"])[0]
+                            faults.clear(
+                                reset_counters=reset.lower()
+                                not in ("", "0", "false")
+                            )
                             doc = faults.snapshot()
                         else:
                             doc = faults.snapshot()

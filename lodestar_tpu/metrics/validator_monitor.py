@@ -9,7 +9,7 @@ metrics and epoch-end log lines.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass

@@ -485,7 +485,7 @@ class Discovery(asyncio.DatagramProtocol):
             try:
                 cb(enr)
             except Exception:
-                pass
+                log.warning("discovery callback failed", exc_info=True)
 
     # -- protocol ops --------------------------------------------------------
 

@@ -260,8 +260,9 @@ def _milestone(chain, name: str, slot: int) -> None:
     if rec is not None:
         try:
             rec(name, slot)
-        except Exception:
-            pass  # milestone telemetry must never fail the handler
+        except Exception as e:
+            # milestone telemetry must never fail the handler
+            log.debug("milestone %s failed: %s", name, e)
 
 
 def _persist_invalid_ssz(obj, kind: str, error: Exception) -> None:
@@ -270,7 +271,9 @@ def _persist_invalid_ssz(obj, kind: str, error: Exception) -> None:
     LODESTAR_TPU_PERSIST_INVALID=<dir>; filenames carry kind + root."""
     import os
 
-    target = os.environ.get("LODESTAR_TPU_PERSIST_INVALID")
+    from ...utils.env import env_str
+
+    target = env_str("LODESTAR_TPU_PERSIST_INVALID")
     if not target:
         return
     try:
@@ -282,5 +285,5 @@ def _persist_invalid_ssz(obj, kind: str, error: Exception) -> None:
         with open(path + ".log", "w") as f:
             f.write(f"{type(error).__name__}: {error}\n")
         log.warning("persisted invalid %s to %s", kind, path)
-    except Exception:
-        pass  # diagnostics only
+    except Exception as e:
+        log.debug("failed to persist invalid %s: %s", kind, e)  # diagnostics only

@@ -15,8 +15,8 @@ from ..utils.logger import get_logger
 from .gossip.gossipsub import Gossipsub, GossipsubService
 from .gossip.handlers import GossipHandlers
 from .gossip.score import PeerScoreParams, ethereum_topic_params
-from .gossip.topic import SUBNET_TYPES, GossipTopic, GossipType, stringify_topic
-from .peers import PeerAction, PeerManager, ScoreState
+from .gossip.topic import GossipTopic, GossipType, stringify_topic
+from .peers import PeerAction, PeerManager
 from .reqresp.handlers import ReqRespHandlers
 from .reqresp.service import RemotePeer, ReqRespService
 from .subnets import AttnetsService
@@ -305,8 +305,9 @@ class Network:
         try:
             status = await self.reqresp.status(peer_id)
             self.peer_manager.on_status(peer_id, status)
-        except Exception:
-            pass  # peers that never answer status get pruned by scoring
+        except Exception as e:
+            # peers that never answer status get pruned by scoring
+            log.debug("status handshake with %s failed: %s", peer_id, e)
 
     def sync_peers(self, loop: asyncio.AbstractEventLoop) -> list[RemotePeer]:
         """RemotePeer views of all connected peers for the sync layer."""
@@ -385,14 +386,14 @@ class Network:
             with open("/proc/self/statm") as f:
                 rss_pages = int(f.read().split()[1])
             m.process_rss_bytes.set(rss_pages * _os.sysconf("SC_PAGE_SIZE"))
-        except Exception:
-            pass
+        except (OSError, ValueError, IndexError):
+            pass  # no /proc (non-Linux): RSS gauge simply stays unset
         try:
             import os as _os
 
             m.open_fds.set(len(_os.listdir("/proc/self/fd")))
-        except Exception:
-            pass
+        except OSError:
+            pass  # no /proc (non-Linux): fd gauge simply stays unset
         for gtype, queue in self.gossip_handlers.queues.items():
             m.gossip_queue_length.set(len(queue), topic=gtype.value)
             seen = self._queue_drops_seen.get(gtype.value, 0)

@@ -9,7 +9,6 @@ keep/prune decision logic are transport-independent.
 
 from __future__ import annotations
 
-import math
 import time
 from dataclasses import dataclass, field
 from enum import Enum

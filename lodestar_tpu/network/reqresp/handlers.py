@@ -7,10 +7,7 @@ ping/metadata from the local metadata object.
 
 from __future__ import annotations
 
-from ...db.repository import Repository
-from ...state_transition import util as st_util
 from .codec import RespCode, encode_error_chunk, encode_response_chunk
-from .protocols import Protocol
 
 MAX_REQUEST_BLOCKS = 1024
 

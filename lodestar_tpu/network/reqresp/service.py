@@ -104,8 +104,9 @@ class ReqRespService:
         try:
             await stream.write(response)
             await stream.close()
-        except Exception:
-            pass
+        except Exception as e:
+            # peer hung up mid-response; scoring already recorded the event
+            log.debug("response write to %s failed: %s", peer_id, e)
 
     # requests are tiny (Status=84B SSZ, ByRoot ≤ 32KiB of roots); anything
     # bigger is hostile — cap buffering so a frame-pumping peer can't balloon

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
 from cryptography.exceptions import InvalidSignature
@@ -466,8 +465,8 @@ class Connection:
         self.streams.clear()
         try:
             self._writer.close()
-        except Exception:
-            pass
+        except Exception as e:
+            log.debug("writer close raced the transport teardown: %s", e)
         self.transport._forget(self)
         for cb in self.on_close:
             cb()

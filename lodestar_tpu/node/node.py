@@ -8,7 +8,7 @@ db.start → metrics → chain → network → sync → api server → metrics s
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..api import BeaconApiServer
 from ..api.impl import BeaconApiImpl
@@ -226,8 +226,9 @@ class BeaconNode:
                     )
                 )
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # phase0 test states lack the flat active-index path
+            self.log.debug("active-validator gauge update failed: %s", e)
         m.current_justified_epoch.set(self.chain.justified_checkpoint[0])
         m.finalized_epoch.set(self.chain.finalized_checkpoint[0])
         m.state_cache_size.set(len(self.chain.state_cache._cache))

@@ -28,12 +28,15 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import logging
 import os
 import threading
 import time
 from collections import deque
 
 from . import trace as _xla_trace
+
+_log = logging.getLogger(__name__)
 
 # milestones recorded against the start of a block's slot (reference:
 # validator-monitor timeliness + the "delay from slot start" dashboards)
@@ -189,14 +192,14 @@ class Tracer:
 
     def __init__(self, capacity: int = 256, enabled: bool | None = None):
         if enabled is None:
-            enabled = os.environ.get(
-                "LODESTAR_TPU_TRACE_LIFECYCLE", "1"
-            ).lower() not in ("0", "false", "off")
+            from ..utils.env import env_bool
+
+            enabled = env_bool("LODESTAR_TPU_TRACE_LIFECYCLE")
         self.enabled = enabled
         self.capacity = capacity
-        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._ring: deque[dict] = deque(maxlen=capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.completed_total = 0
+        self.completed_total = 0  # guarded-by: _lock
         # callbacks(trace_doc) — node wiring increments the prometheus
         # lifecycle-trace counter here
         self.on_finish: list = []
@@ -279,7 +282,8 @@ class Tracer:
             try:
                 cb(doc)
             except Exception:
-                pass  # observers must never break the traced path
+                # observers must never break the traced path
+                _log.debug("on_finish observer failed", exc_info=True)
 
     def traces(self, slot=None, root=None, limit: int = 64) -> list[dict]:
         """Recent traces, newest first, optionally filtered by slot or

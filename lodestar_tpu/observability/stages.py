@@ -66,8 +66,8 @@ def _block_until_ready(x):
         import jax
 
         jax.block_until_ready(x)
-    except Exception:
-        pass
+    except ImportError:
+        pass  # no jax: the value was computed eagerly, nothing to wait on
 
 
 class _StageTimer:

@@ -19,7 +19,7 @@ double-start the XLA trace.
 from __future__ import annotations
 
 import contextlib
-import os
+import logging
 import threading
 
 _lock = threading.Lock()
@@ -55,8 +55,10 @@ def start_profiling(trace_dir: str | None = None) -> str | None:
     actually used, or None if a trace is already running or jax/profiler
     is unavailable. Idempotent under races (one trace at a time)."""
     global _active_dir
-    trace_dir = trace_dir or os.environ.get(
-        "LODESTAR_TPU_PROFILE", "/tmp/lodestar_tpu_profile"
+    from ..utils.env import env_str
+
+    trace_dir = (
+        trace_dir or env_str("LODESTAR_TPU_PROFILE") or "/tmp/lodestar_tpu_profile"
     )
     with _lock:
         if _active_dir is not None:
@@ -83,6 +85,8 @@ def stop_profiling() -> str | None:
             import jax
 
             jax.profiler.stop_trace()
-        except Exception:
-            pass
+        except Exception as e:
+            # the switch still resets: a profiler that died mid-trace must
+            # not wedge the process-wide start/stop toggle
+            logging.getLogger(__name__).debug("stop_trace failed: %s", e)
         return stopped

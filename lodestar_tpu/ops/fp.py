@@ -546,21 +546,21 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     (round 1's opt-in flag) forces the `mxu_fp.mul` MXU/Kogge–Stone
     variant.
     """
-    import os
+    from ..utils.env import env_bool
 
-    if os.environ.get("LODESTAR_TPU_PADCONV_FP") == "1":
+    if env_bool("LODESTAR_TPU_PADCONV_FP"):
         return _mul_padconv(a, b)
-    if os.environ.get("LODESTAR_TPU_PALLAS_MXU") == "1":
+    if env_bool("LODESTAR_TPU_PALLAS_MXU"):
         from .pallas_mxu import mont_mul
 
         return mont_mul(a, b)
-    if os.environ.get("LODESTAR_TPU_PALLAS_MUL") == "1":
+    if env_bool("LODESTAR_TPU_PALLAS_MUL"):
         from .pallas_fp import mont_mul
 
         return mont_mul(a, b)
-    if os.environ.get("LODESTAR_TPU_LEGACY_FP") == "1":
+    if env_bool("LODESTAR_TPU_LEGACY_FP"):
         return _mul_scan(a, b)
-    if os.environ.get("LODESTAR_TPU_MXU_MUL") == "1":
+    if env_bool("LODESTAR_TPU_MXU_MUL"):
         from . import mxu_fp
 
         return mxu_fp.mul(a, b)

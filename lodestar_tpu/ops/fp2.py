@@ -59,16 +59,15 @@ def _bcast(a, b):
 
 
 def _lazy_enabled() -> bool:
-    import os
+    from ..utils.env import env_bool
 
-    return os.environ.get("LODESTAR_TPU_LAZY_FP2", "1") != "0"
+    return env_bool("LODESTAR_TPU_LAZY_FP2")
 
 
 def _lazy_max_elems() -> int:
-    import os
+    from ..utils.env import env_int
 
-    v = os.environ.get("LODESTAR_TPU_LAZY_FP2_MAX_ELEMS")
-    return int(v) if v else 1 << 24
+    return env_int("LODESTAR_TPU_LAZY_FP2_MAX_ELEMS")
 
 
 def _use_lazy(big_a) -> bool:

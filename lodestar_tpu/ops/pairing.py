@@ -30,7 +30,7 @@ import numpy as np
 from jax import lax
 
 from ..bls.fields import X_PARAM
-from . import fp, fp2, fp6, fp12
+from . import fp, fp2, fp12
 from .points import g2
 
 X_ABS = abs(X_PARAM)

@@ -226,10 +226,10 @@ MIN_LANES = 4096  # below this, the ~200 us per-call launch latency loses
 
 
 def _min_lanes() -> int:
-    import os
+    from ..utils.env import env_int
 
-    v = os.environ.get("LODESTAR_TPU_PALLAS_MIN_LANES")
-    return int(v) if v else MIN_LANES
+    v = env_int("LODESTAR_TPU_PALLAS_MIN_LANES")
+    return v if v else MIN_LANES
 
 
 def mont_mul(

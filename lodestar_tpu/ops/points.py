@@ -16,7 +16,6 @@ reference: blst verifyMultipleSignatures' rand-scaling).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax.numpy as jnp
 from jax import lax

@@ -29,7 +29,6 @@ device lists, no kernel compiles involved.
 
 from __future__ import annotations
 
-import os
 import threading
 
 from ..observability import trace
@@ -251,7 +250,9 @@ def auto_mesh(observer: PipelineMetrics | None = None):
     Returns a BlsMeshDispatcher or None. Never raises: a verifier must
     construct even when jax device enumeration is broken (the supervisor
     owns that failure)."""
-    mode = os.environ.get("LODESTAR_TPU_MESH", "auto").strip().lower()
+    from ..utils.env import env_str
+
+    mode = (env_str("LODESTAR_TPU_MESH") or "auto").strip().lower()
     if mode in ("0", "off", "false", "none"):
         return None
     try:

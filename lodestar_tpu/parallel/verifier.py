@@ -51,7 +51,6 @@ from ..ops.pairing import (
     final_exponentiation_batch,
     miller_loop,
     miller_loop_proj_pq,
-    miller_loop_projective,
 )
 from ..ops.points import (
     G1_GEN_X,
@@ -549,9 +548,11 @@ _POOL_SIZE = 0
 def marshal_pool_size() -> int:
     import os
 
-    override = os.environ.get("LODESTAR_TPU_MARSHAL_THREADS")
-    if override:
-        return max(0, int(override))
+    from ..utils.env import env_int
+
+    override = env_int("LODESTAR_TPU_MARSHAL_THREADS")
+    if override is not None:
+        return max(0, override)
     return os.cpu_count() or 1
 
 
@@ -795,10 +796,10 @@ class TpuBlsVerifier:
         # headroom — a cap BELOW the active set would thrash to 0% hits
         # at exactly the target scale. Smaller hosts should set
         # LODESTAR_TPU_PK_CACHE_MAX (2^20 ≈ 0.55 GB still covers 1M).
-        self._pk_cache: dict[bytes, "np.ndarray"] = {}
-        self._pk_cache_max = int(
-            __import__("os").environ.get("LODESTAR_TPU_PK_CACHE_MAX", 1 << 21)
-        )
+        from ..utils.env import env_bool, env_int
+
+        self._pk_cache: dict[bytes, "np.ndarray"] = {}  # guarded-by: _pk_lock
+        self._pk_cache_max = env_int("LODESTAR_TPU_PK_CACHE_MAX")
         self._pk_lock = threading.Lock()
         # On-device signature decompression + batched plane subgroup
         # checks (ops/g2_decompress): removes the ~0.6 ms/set C-tier
@@ -813,12 +814,7 @@ class TpuBlsVerifier:
         # can't marshal fall back to the host path automatically
         # (`_native_eligible` gates every raw dispatch).
         if device_decompress is None:
-            device_decompress = (
-                __import__("os").environ.get(
-                    "LODESTAR_TPU_DEVICE_DECOMPRESS", "1"
-                ).lower()
-                not in ("0", "off", "false")
-            )
+            device_decompress = env_bool("LODESTAR_TPU_DEVICE_DECOMPRESS")
         self._device_decompress = bool(device_decompress)
         # Mesh serving (round 7): grouped/pk-grouped/bisect batches
         # dispatch across every visible chip via parallel/mesh. The

@@ -9,13 +9,12 @@ calling :func:`set_active_preset` before any consensus objects are built.
 
 from __future__ import annotations
 
-import os
-
+from ..utils.env import env_str
 from .constants import *  # noqa: F401,F403
 from .fork_name import EXECUTION_FORKS, FORK_ORDER, ForkName, ForkSeq, fork_seq  # noqa: F401
 from .presets import MAINNET, MINIMAL, PRESETS, Preset  # noqa: F401
 
-ACTIVE_PRESET: Preset = PRESETS.get(os.environ.get("LODESTAR_TPU_PRESET", "mainnet"), MAINNET)
+ACTIVE_PRESET: Preset = PRESETS.get(env_str("LODESTAR_TPU_PRESET"), MAINNET)
 
 
 def set_active_preset(name_or_preset: str | Preset) -> Preset:

@@ -23,17 +23,12 @@ from ..params import (
     PROPOSER_WEIGHT,
     SYNC_REWARD_WEIGHT,
     TIMELY_HEAD_FLAG_INDEX,
-    TIMELY_HEAD_WEIGHT,
     TIMELY_SOURCE_FLAG_INDEX,
-    TIMELY_SOURCE_WEIGHT,
     TIMELY_TARGET_FLAG_INDEX,
-    TIMELY_TARGET_WEIGHT,
     WEIGHT_DENOMINATOR,
-    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
 )
 from . import util
 from .block import (
-    BlockProcessingError,
     _require,
     decrease_balance,
     get_attesting_indices,
@@ -280,7 +275,6 @@ def process_inactivity_updates(cached) -> None:
 
 
 def process_justification_and_finalization_altair(cached, types) -> None:
-    from .epoch import process_justification_and_finalization as _p0
 
     state, p, flat = cached.state, cached.preset, cached.flat
     current_epoch = cached.current_epoch

@@ -13,7 +13,7 @@ for spec-test parity.
 from __future__ import annotations
 
 from . import util
-from .block import BlockProcessingError, _require
+from .block import _require
 
 
 def is_merge_transition_complete(state) -> bool:

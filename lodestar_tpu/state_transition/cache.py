@@ -11,14 +11,13 @@ can be lifted to device arrays wholesale).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
 from ..params import (
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
-    FAR_FUTURE_EPOCH,
     GENESIS_EPOCH,
 )
 from . import util

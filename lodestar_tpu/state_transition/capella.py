@@ -18,7 +18,6 @@ from ..params import (
     BLS_WITHDRAWAL_PREFIX,
     DOMAIN_BLS_TO_EXECUTION_CHANGE,
     ETH1_ADDRESS_WITHDRAWAL_PREFIX,
-    FAR_FUTURE_EPOCH,
 )
 from ..ssz.hashing import sha256
 from . import util

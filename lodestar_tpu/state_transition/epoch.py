@@ -25,7 +25,7 @@ from ..params import (
     JUSTIFICATION_BITS_LENGTH,
 )
 from . import util
-from .block import get_validator_churn_limit, increase_balance
+from .block import get_validator_churn_limit
 
 U64 = np.uint64
 

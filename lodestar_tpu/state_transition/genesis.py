@@ -8,12 +8,11 @@ reference: `beacon-node/src/chain/genesis/genesis.ts`).
 
 from __future__ import annotations
 
-from ..bls.api import SecretKey, interop_secret_key
+from ..bls.api import interop_secret_key
 from ..config.beacon_config import compute_domain, compute_signing_root
 from ..params import (
     DEPOSIT_CONTRACT_TREE_DEPTH,
     DOMAIN_DEPOSIT,
-    FAR_FUTURE_EPOCH,
     GENESIS_EPOCH,
 )
 from ..ssz.hashing import sha256

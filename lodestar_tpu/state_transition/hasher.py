@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ssz.core import ListType, VectorType
 from ..ssz.hashing import merkleize_chunks, mix_in_length
 from ..ssz.tree_cache import ChunkTree, _hash_rows, rows_ne
 

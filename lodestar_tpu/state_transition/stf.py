@@ -9,7 +9,6 @@ processing, optional post-state root verification.
 from __future__ import annotations
 
 from ..params import ForkName
-from . import util
 from .block import BlockProcessingError, process_block
 from .epoch import process_epoch
 

@@ -11,11 +11,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..params import (
-    DOMAIN_BEACON_PROPOSER,
-    FAR_FUTURE_EPOCH,
-    GENESIS_EPOCH,
-)
 from ..ssz.hashing import sha256
 
 UINT64_MAX = 2**64 - 1

@@ -11,7 +11,10 @@ plan is armed via:
 - the environment: ``LODESTAR_TPU_FAULTS="exception,latency:0.05"``
   (read at import, so a whole test process or drill node starts faulty);
 - the metrics server: ``POST /debug/faults?set=deadline:30`` /
-  ``?clear=1`` (live toggling mid-drill, no restart).
+  ``?clear=1`` (live toggling mid-drill, no restart);
+  ``?clear=1&reset_counters=1`` also zeroes the injection counters
+  (drill teardown — otherwise they persist so a degraded run stays
+  self-labelled).
 
 Modes (comma-separated, each with an optional ``:param``):
 
@@ -44,10 +47,11 @@ with faults armed is self-labelling (tools/bench_compare.py skips it).
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
+
+from ..utils.env import env_str
 
 
 class InjectedFault(RuntimeError):
@@ -92,7 +96,27 @@ def _parse(spec: str) -> dict[str, float]:
             raise ValueError(
                 f"unknown fault mode {name!r} (known: {sorted(_MODE_DEFAULTS)})"
             )
-        plan[name] = float(param) if param else _MODE_DEFAULTS[name]
+        if not param:
+            value = _MODE_DEFAULTS[name]
+        else:
+            try:
+                value = float(param)
+            except ValueError:
+                raise ValueError(
+                    f"fault mode {name!r}: parameter {param!r} is not a "
+                    f"number (expected e.g. '{name}:{_MODE_DEFAULTS[name]}')"
+                ) from None
+            if value < 0:
+                raise ValueError(
+                    f"fault mode {name!r}: parameter must be >= 0, "
+                    f"got {param!r}"
+                )
+            if name == "chip" and not value.is_integer():
+                raise ValueError(
+                    "fault mode 'chip': parameter must be an integer chip "
+                    f"index, got {param!r}"
+                )
+        plan[name] = value
     return plan
 
 
@@ -195,6 +219,6 @@ def flaky_verdicts(verdicts: list[bool]) -> list[bool]:
 
 # arm from the environment at import: a drill node (or a fault-injected
 # test subprocess) starts with the plan already live
-_env_spec = os.environ.get("LODESTAR_TPU_FAULTS")
+_env_spec = env_str("LODESTAR_TPU_FAULTS")
 if _env_spec:
     configure(_env_spec)

@@ -20,7 +20,6 @@ from ..ssz import (
     BLSPubkey,
     BLSSignature,
     Bytes32,
-    Container,
     ListType,
     VectorType,
     uint8,

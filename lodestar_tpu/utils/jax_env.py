@@ -37,7 +37,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     `tools/warmup.py` pass serves them all. Returns the active directory,
     or None when disabled. Safe to call before or after backend init
     (`jax_compilation_cache_dir` is a runtime config)."""
-    env = os.environ.get("LODESTAR_TPU_COMPILE_CACHE")
+    from .env import raw
+
+    env = raw("LODESTAR_TPU_COMPILE_CACHE")
     if env is not None and env.strip().lower() in ("0", "off", "none", ""):
         return None
     cache = env or cache_dir or default_cache_dir()

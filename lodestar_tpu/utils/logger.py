@@ -25,8 +25,9 @@ class _TraceContextFilter(logging.Filter):
             from ..observability.spans import current_trace_id
 
             tid = current_trace_id()
-        except Exception:
-            pass
+        except ImportError:
+            pass  # circular import during startup; logging inside a log
+            # filter would recurse, so stay silent and render no trace id
         record.trace = f" [t:{tid[:8]}]" if tid else ""
         return True
 

@@ -42,7 +42,11 @@ class RestValidatorService:
         for pk in unresolved:
             try:
                 entry = self.client.getStateValidator("head", "0x" + pk.hex())
-            except Exception:
+            except Exception as e:
+                # unresolved keys retry on the next duty poll
+                self.log.debug(
+                    "getStateValidator(%s…) failed: %s", pk.hex()[:8], e
+                )
                 continue
             if entry is not None:
                 self._indices[pk] = int(entry["index"])

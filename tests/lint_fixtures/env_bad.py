@@ -1,0 +1,30 @@
+"""Planted env-registry violations (fixture — never imported)."""
+
+import os
+
+
+def raw_getenv():
+    return os.getenv("LODESTAR_TPU_SOME_KNOB")  # 1: raw read
+
+
+def raw_environ_get():
+    return os.environ.get("LODESTAR_TPU_OTHER_KNOB", "1")  # 2: raw read
+
+
+def raw_subscript():
+    return os.environ["LODESTAR_TPU_THIRD_KNOB"]  # 3: raw subscript read
+
+
+def unregistered_typed_read():
+    from lodestar_tpu.utils.env import env_bool
+
+    return env_bool("LODESTAR_TPU_NOT_A_REAL_KNOB")  # 4: not in registry
+
+
+def allowed_write():
+    os.environ["LODESTAR_TPU_SOME_KNOB"] = "1"  # writes are legal
+    return None
+
+
+def allowed_other_prefix():
+    return os.getenv("XLA_FLAGS")  # non-LODESTAR knobs are out of scope

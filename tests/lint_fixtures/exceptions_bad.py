@@ -1,0 +1,39 @@
+"""Planted exception-hygiene violations (fixture — never imported)."""
+
+
+def bare_except():
+    try:
+        return 1 / 0
+    except:  # 1: bare except
+        return None
+
+
+def silent_broad():
+    try:
+        return 1 / 0
+    except Exception:  # 2: silently swallowed
+        pass
+
+
+def silent_broad_continue():
+    for i in range(3):
+        try:
+            _ = 1 / i
+        except Exception:  # 3: silently swallowed via continue
+            continue
+    return None
+
+
+def handled_broad(log):
+    try:
+        return 1 / 0
+    except Exception as e:  # acting on the error: fine
+        log.warning("division failed: %s", e)
+        return None
+
+
+def narrow_silent():
+    try:
+        return 1 / 0
+    except ZeroDivisionError:  # narrow + silent: fine (explicit contract)
+        pass

@@ -1,0 +1,39 @@
+"""Planted lock-discipline violations (fixture — never imported)."""
+
+import threading
+import time
+
+
+class Buffered:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []  # guarded-by: _lock
+        self._done = threading.Event()
+
+    def add_locked(self, item):
+        with self._lock:
+            self._entries.append(item)  # attribute method call: fine
+            self._entries = list(self._entries)  # rebind under lock: fine
+
+    def add_unlocked(self, item):
+        self._entries = [item]  # 1: guarded write without the lock
+
+    def add_conditionally(self, item):
+        if item:
+            self._entries = [item]  # 2: guarded write in a branch, no lock
+
+    def sleep_while_locked(self):
+        with self._lock:
+            time.sleep(0.5)  # 3: blocking call while holding the lock
+
+    def wait_while_locked(self):
+        with self._lock:
+            self._done.wait()  # 4: untimed wait while holding the lock
+
+    def wait_bounded(self):
+        with self._lock:
+            return self._done.wait(timeout=1.0)  # bounded: fine
+
+    def join_while_locked(self, worker):
+        with self._lock:
+            worker.join()  # 5: unbounded join while holding the lock

@@ -1,0 +1,29 @@
+"""Planted metric-discipline violations (fixture — never imported)."""
+
+
+class FixtureMetrics:
+    def __init__(self, registry):
+        self.batches = registry.counter(
+            "lodestar_fixture_batches", "batches", ("outcome",)
+        )
+        # 1: same family redeclared with a different label set
+        self.batches_dup = registry.counter(
+            "lodestar_fixture_batches", "batches", ("result", "tier")
+        )
+        self.depth = registry.gauge("lodestar_fixture_depth", "queue depth")
+        self.latency = registry.summary(
+            "lodestar_fixture_latency", "seconds", ("stage",)
+        )
+        # 3: declared, never touched again, not on any dashboard
+        self.orphan = registry.counter("lodestar_fixture_orphan", "unused")
+
+    def record(self, ok):
+        self.batches.inc(outcome="ok" if ok else "fail")
+        self.depth.set(3.0)
+        # 2: label name disagrees with the declaration ("stage")
+        self.latency.observe(0.5, phase="verify")
+
+
+def scrape_filter():
+    # 4: full-string literal that matches no declared family
+    return ["lodestar_fixture_nonexistent_total"]

@@ -1,0 +1,64 @@
+"""Planted trace-safety violations (fixture — never imported; linted as
+text by tests/test_lint.py). Each numbered site must produce a finding."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel_item(x):
+    return x.item()  # 1: host sync under trace
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def kernel_static(x, flag=True):
+    if flag:
+        return x + 1
+    return x
+
+
+def call_sites(x):
+    # 2: unhashable list in a static position
+    return kernel_static(x, flag=[1, 2])
+
+
+@jax.jit
+def kernel_asarray(x):
+    return np.asarray(x)  # 3: device->host pull
+
+
+@jax.jit
+def kernel_branch(x):
+    if jnp.any(x > 0):  # 4: Python branch on a traced value
+        return x
+    return -x
+
+
+@jax.jit
+def kernel_float(x):
+    return float(x) * 2.0  # 5: ConcretizationTypeError at trace time
+
+
+@jax.jit
+def kernel_sync(x):
+    y = (x * 2).block_until_ready()  # 6: device sync under trace
+    return y
+
+
+def _helper(x):
+    return x.tolist()  # 7: transitive — called from a kernel below
+
+
+@jax.jit
+def kernel_transitive(x):
+    return _helper(x)
+
+
+def body(x):
+    return jax.device_get(x)  # 8: kernel-ness via jit() call reference
+
+
+wrapped = jax.jit(body)

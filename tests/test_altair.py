@@ -2,7 +2,6 @@
 accounting, sync aggregates, finality (reference analog: altair sanity +
 finality spec suites, fork transition tests)."""
 
-import numpy as np
 import pytest
 
 from lodestar_tpu.bls import api as bls

@@ -9,7 +9,6 @@ from lodestar_tpu.network.reqresp.handlers import ReqRespHandlers
 from lodestar_tpu.sync import LocalPeer
 from lodestar_tpu.sync.backfill import BackfillError, BackfillSync
 from lodestar_tpu.params.presets import MINIMAL
-from lodestar_tpu.types import get_types
 from tests.test_sync import two_nodes  # noqa: F401  (fixture reuse)
 
 SPE = MINIMAL.SLOTS_PER_EPOCH

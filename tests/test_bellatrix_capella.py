@@ -17,7 +17,6 @@ from lodestar_tpu.params import (
     ForkName,
 )
 from lodestar_tpu.params.presets import MINIMAL
-from lodestar_tpu.ssz.hashing import sha256
 from lodestar_tpu.state_transition import (
     CachedBeaconState,
     interop_genesis_state,
@@ -28,13 +27,11 @@ from lodestar_tpu.state_transition.altair import upgrade_state_to_altair
 from lodestar_tpu.state_transition.bellatrix import (
     is_execution_enabled,
     is_merge_transition_complete,
-    upgrade_state_to_bellatrix,
 )
 from lodestar_tpu.state_transition.block import _epoch_signing_root
 from lodestar_tpu.state_transition.capella import (
     get_expected_withdrawals,
     process_bls_to_execution_change,
-    upgrade_state_to_capella,
 )
 from lodestar_tpu.state_transition.signature_sets import get_block_signature_sets
 from lodestar_tpu.chain.bls_verifier import CpuBlsVerifier

@@ -244,3 +244,58 @@ def test_timed_out_details_do_not_augment(tmp_path, capsys):
     }))
     assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 0
     capsys.readouterr()
+
+
+def test_empty_history_dir_exits_zero(tmp_path, capsys):
+    """A directory with no BENCH files at all (fresh checkout) is a clean
+    exit-0 'nothing to gate' — never a traceback."""
+    mod = _load()
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "no parseable bench history" in out
+
+
+def test_null_round_file_is_skipped_not_crashed(tmp_path, capsys):
+    """A round file containing JSON `null` (a harness that died while
+    writing) must be a logged skip, not an AttributeError."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text("null")
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "not a JSON object" in out
+    assert "nothing to gate" in out
+
+
+def test_non_dict_parsed_is_skipped_not_crashed(tmp_path, capsys):
+    """`parsed` holding a string/list (a corrupted emitter document) must
+    be a logged skip, not a crash in the timed_out/degraded probes."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": "watchdog killed mid-write"})
+    )
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"n": 2, "parsed": [1, 2, 3]})
+    )
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "`parsed` is not a JSON object" in out
+
+
+def test_truncated_round_file_is_logged_skip(tmp_path, capsys):
+    """Half-written JSON (disk full / kill -9) is an unreadable-file skip
+    with the parse error in the note."""
+    mod = _load()
+    (tmp_path / "BENCH_r01.json").write_text('{"n": 1, "parsed": {')
+    (tmp_path / "BENCH_r02.json").write_text("")
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert out.count("unreadable round file") == 2
+
+
+def test_single_parseable_round_exits_zero(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    (tmp_path / "BENCH_r02.json").write_text("null")
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1 parseable round" in out

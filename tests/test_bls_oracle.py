@@ -17,17 +17,14 @@ from lodestar_tpu.bls import (
     SecretKey,
     Signature,
     SignatureSet,
-    aggregate_pubkeys,
     aggregate_signatures,
     aggregate_verify,
     fast_aggregate_verify,
-    hash_to_g2,
     interop_secret_key,
     verify,
     verify_signature_sets,
 )
 from lodestar_tpu.bls.curve import PointG1, PointG2, g1_from_bytes, g1_to_bytes
-from lodestar_tpu.bls.fields import Fq12
 from lodestar_tpu.bls.hash_to_curve import expand_message_xmd
 from lodestar_tpu.bls.pairing import (
     final_exponentiation,

@@ -7,7 +7,6 @@ import pytest
 
 from lodestar_tpu.bls import api as bls
 from lodestar_tpu.chain import BeaconChain, CpuBlsVerifier
-from lodestar_tpu.chain.clock import ManualClock
 from lodestar_tpu.chain.op_pools import AttestationPool
 from lodestar_tpu.chain.seen_cache import SeenAggregatedAttestations, SeenByEpoch
 from lodestar_tpu.chain.state_cache import StateContextCache
@@ -335,3 +334,64 @@ def test_irrecoverable_fault_window_triggers_shutdown(chain_env):
     with pytest.raises(RuntimeError):
         chain.update_head()
     assert calls and "irrecoverable" in calls[0]
+
+
+# -- bounded serving-path waits (LODESTAR_TPU_IMPORT_WAIT_TIMEOUT) -----------
+
+
+def test_bounded_wait_times_out_and_escalates(monkeypatch):
+    """A never-completing future must fail the import within the bound,
+    incrementing the site-labelled escalation counter — never hang."""
+    from concurrent.futures import Future
+    from types import SimpleNamespace
+
+    from lodestar_tpu.chain.chain import BlockImportError, _bounded_result
+
+    monkeypatch.setenv("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT", "0.05")
+    calls = []
+    m = SimpleNamespace(
+        blocking_wait_timeouts_total=SimpleNamespace(
+            inc=lambda **labels: calls.append(labels)
+        )
+    )
+    fut = Future()  # never resolved: a wedged EL socket / dead worker
+    with pytest.raises(BlockImportError, match="IMPORT_WAIT_TIMEOUT"):
+        _bounded_result(fut, "block_payload", m)
+    assert calls == [{"site": "block_payload"}]
+
+
+def test_bounded_wait_timeout_without_metrics_bundle(monkeypatch):
+    """The bound holds even before metrics are wired (m=None)."""
+    from concurrent.futures import Future
+
+    from lodestar_tpu.chain.chain import BlockImportError, _bounded_result
+
+    monkeypatch.setenv("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT", "0.05")
+    with pytest.raises(BlockImportError):
+        _bounded_result(Future(), "segment_payload", None)
+
+
+def test_bounded_wait_zero_disables_the_bound(monkeypatch):
+    """<= 0 means unbounded (operator opt-out); a resolved future still
+    returns its value immediately."""
+    from concurrent.futures import Future
+
+    from lodestar_tpu.chain.chain import _bounded_result
+
+    monkeypatch.setenv("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT", "0")
+    fut = Future()
+    fut.set_result("VALID")
+    assert _bounded_result(fut, "block_payload", None) == "VALID"
+
+
+def test_bounded_wait_passes_through_future_exception(monkeypatch):
+    """A future that fails fast re-raises its own error, not a timeout."""
+    from concurrent.futures import Future
+
+    from lodestar_tpu.chain.chain import _bounded_result
+
+    monkeypatch.setenv("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT", "5")
+    fut = Future()
+    fut.set_exception(RuntimeError("payload INVALID"))
+    with pytest.raises(RuntimeError, match="payload INVALID"):
+        _bounded_result(fut, "block_payload", None)

@@ -3,7 +3,6 @@ archive dual-index — mirroring the reference's db unit/e2e coverage."""
 
 import os
 
-import pytest
 
 from lodestar_tpu.db import BeaconDb, Bucket, FileDb, MemoryDb, Repository
 from lodestar_tpu.params.presets import MINIMAL

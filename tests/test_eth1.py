@@ -2,7 +2,6 @@
 process_operations proof checks, eth1 vote rule (reference: eth1 unit
 tests + deposit inclusion e2e)."""
 
-import pytest
 
 from lodestar_tpu.chain import BeaconChain
 from lodestar_tpu.config.beacon_config import BeaconConfig, ChainForkConfig
@@ -14,7 +13,7 @@ from lodestar_tpu.state_transition import interop_genesis_state, process_slots
 from lodestar_tpu.state_transition.block import _epoch_signing_root
 from lodestar_tpu.state_transition.genesis import make_interop_deposits
 from lodestar_tpu.types import get_types
-from tests.test_chain import _sign_block, _sk
+from tests.test_chain import _sk
 
 N = 16
 SPE = MINIMAL.SLOTS_PER_EPOCH

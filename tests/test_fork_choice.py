@@ -4,7 +4,6 @@ viability filtering, pruning — the behaviors the reference exercises via
 protoArray unit tests."""
 
 import numpy as np
-import pytest
 
 from lodestar_tpu.fork_choice import ForkChoice, ForkChoiceStore, ProtoArray
 

@@ -1,0 +1,302 @@
+"""Tier-1 enforcement of graftlint (tools/lint) + the generated config docs.
+
+Two halves, per the invariant-checker contract:
+
+* the real tree is CLEAN — `python -m tools.lint` over lodestar_tpu/,
+  tools/, bench.py, __graft_entry__.py yields zero findings;
+* every rule demonstrably FIRES — each planted-violation fixture in
+  tests/lint_fixtures/ produces the expected findings, and the
+  rules-fire matrix fails if a checker is deleted or unwired.
+
+Plus suppression semantics, CLI exit codes / JSON output, and the
+docs/configuration.md drift check (tools/gen_config_docs.py --check).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+sys.path.insert(0, REPO_ROOT)
+
+from tools.lint import all_checkers, render, rule_names, run  # noqa: E402
+
+EXPECTED_RULES = {
+    "trace-safety",
+    "lock-discipline",
+    "env-registry",
+    "exception-hygiene",
+    "metric-discipline",
+}
+
+
+def lint_fixture(name: str):
+    return run(paths=[os.path.join(FIXTURES, name)], root=REPO_ROOT)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- the real tree is clean --------------------------------------------------
+
+
+def test_repo_tree_has_no_findings():
+    findings = run(root=REPO_ROOT)
+    assert not findings, "graftlint found violations:\n" + render(findings)
+
+
+# -- every rule fires on its fixture -----------------------------------------
+
+
+def test_registered_rule_set():
+    assert set(rule_names()) == EXPECTED_RULES
+    assert len(all_checkers()) == len(EXPECTED_RULES)
+
+
+def test_trace_safety_fixture_fires():
+    findings = lint_fixture("trace_bad.py")
+    assert rules_of(findings) == ["trace-safety"] * 8
+    lines = {f.line for f in findings}
+    messages = "\n".join(f.message for f in findings)
+    assert len(lines) == 8  # one finding per planted site
+    for marker in (".item()", "np.asarray", "Python `if`", "float(",
+                   ".tolist()", "jax.device_get", ".block_until_ready()",
+                   "unhashable list"):
+        assert marker in messages
+
+
+def test_lock_discipline_fixture_fires():
+    findings = lint_fixture("locks_bad.py")
+    assert rules_of(findings) == ["lock-discipline"] * 5
+    messages = "\n".join(f.message for f in findings)
+    assert messages.count("guarded-by") == 2
+    assert "time.sleep" in messages
+    assert "untimed .wait()" in messages
+    assert ".join()" in messages
+
+
+def test_env_registry_fixture_fires():
+    findings = lint_fixture("env_bad.py")
+    assert rules_of(findings) == ["env-registry"] * 4
+    messages = "\n".join(f.message for f in findings)
+    assert "LODESTAR_TPU_SOME_KNOB" in messages
+    assert "LODESTAR_TPU_OTHER_KNOB" in messages
+    assert "LODESTAR_TPU_THIRD_KNOB" in messages
+    assert "not registered" in messages  # the typo'd accessor name
+
+
+def test_exception_hygiene_fixture_fires():
+    findings = lint_fixture("exceptions_bad.py")
+    assert rules_of(findings) == ["exception-hygiene"] * 3
+    messages = "\n".join(f.message for f in findings)
+    assert "bare `except:`" in messages
+    assert "silently swallows" in messages
+
+
+def test_metric_discipline_fixture_fires():
+    findings = lint_fixture("metrics_bad.py")
+    assert rules_of(findings) == ["metric-discipline"] * 4
+    messages = "\n".join(f.message for f in findings)
+    assert "redeclared" in messages
+    assert "does not match any declared metric family" in messages
+    assert "declaration expects" in messages
+    assert "never used" in messages
+
+
+def test_every_rule_fires_somewhere():
+    """The self-test the issue demands: deleting (or unwiring) any checker
+    turns this red, because its fixture findings disappear."""
+    fired = set()
+    for name in os.listdir(FIXTURES):
+        if name.endswith(".py"):
+            fired.update(rules_of(lint_fixture(name)))
+    assert fired == EXPECTED_RULES
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_line_suppression(tmp_path):
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:  # graftlint: disable=exception-hygiene\n"
+        "        pass\n"
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert run(paths=[str(p)], root=REPO_ROOT) == []
+
+
+def test_line_suppression_is_rule_specific(tmp_path):
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:  # graftlint: disable=trace-safety\n"
+        "        pass\n"
+    )
+    p = tmp_path / "wrong_rule.py"
+    p.write_text(src)
+    findings = run(paths=[str(p)], root=REPO_ROOT)
+    assert rules_of(findings) == ["exception-hygiene"]
+
+
+def test_file_suppression(tmp_path):
+    src = (
+        "# graftlint: disable-file=exception-hygiene\n"
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "def g():\n"
+        "    try:\n"
+        "        return 2\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    p = tmp_path / "filewide.py"
+    p.write_text(src)
+    assert run(paths=[str(p)], root=REPO_ROOT) == []
+
+
+def test_suppression_all(tmp_path):
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:  # graftlint: disable=all\n"
+        "        pass\n"
+    )
+    p = tmp_path / "all_off.py"
+    p.write_text(src)
+    assert run(paths=[str(p)], root=REPO_ROOT) == []
+
+
+def test_suppression_in_string_literal_does_not_apply(tmp_path):
+    src = (
+        'MARKER = "graftlint: disable=exception-hygiene"\n'
+        "def f():\n"
+        "    try:\n"
+        "        return MARKER\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    p = tmp_path / "string_trap.py"
+    p.write_text(src)
+    findings = run(paths=[str(p)], root=REPO_ROOT)
+    assert rules_of(findings) == ["exception-hygiene"]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.lint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exit_nonzero_on_findings():
+    proc = _cli(os.path.join("tests", "lint_fixtures", "exceptions_bad.py"))
+    assert proc.returncode == 1
+    assert "exception-hygiene" in proc.stdout
+
+
+def test_cli_exit_zero_on_clean_file():
+    proc = _cli(os.path.join("tools", "lint", "__main__.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no findings" in proc.stdout
+
+
+def test_cli_json_output():
+    proc = _cli("--json",
+                os.path.join("tests", "lint_fixtures", "env_bad.py"))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["count"] == len(doc["findings"]) == 4
+    assert {f["rule"] for f in doc["findings"]} == {"env-registry"}
+    assert all(
+        set(f) == {"path", "line", "col", "rule", "message"}
+        for f in doc["findings"]
+    )
+
+
+def test_cli_rules_subset():
+    proc = _cli("--rules", "exception-hygiene",
+                os.path.join("tests", "lint_fixtures", "trace_bad.py"))
+    assert proc.returncode == 0  # trace violations invisible to that rule
+    proc = _cli("--rules", "trace-safety",
+                os.path.join("tests", "lint_fixtures", "trace_bad.py"))
+    assert proc.returncode == 1
+
+
+def test_cli_unknown_rule_is_usage_error():
+    proc = _cli("--rules", "no-such-rule")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in EXPECTED_RULES:
+        assert rule in proc.stdout
+
+
+# -- ruff error-class gate (optional tool, gated) ----------------------------
+
+
+def test_ruff_error_classes_clean():
+    """When ruff is available, the E9/F-only gate configured in
+    pyproject [tool.ruff] must pass over the lintable tree. The
+    container does not ship ruff — the test skips rather than fails, and
+    the F-class true positives were fixed by hand (see the unused-import
+    sweep in this PR)."""
+    import shutil
+
+    if shutil.which("ruff") is None:
+        pytest.skip("ruff not installed in this environment")
+    proc = subprocess.run(
+        ["ruff", "check", "lodestar_tpu", "tools", "tests", "bench.py"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- generated configuration docs stay fresh ---------------------------------
+
+
+def test_config_docs_not_stale():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "gen_config_docs.py"),
+         "--check"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (
+        "docs/configuration.md is stale — regenerate with "
+        "`python tools/gen_config_docs.py`\n" + proc.stdout + proc.stderr
+    )
+
+
+def test_env_registry_covers_every_knob_reference():
+    """No raw LODESTAR_TPU_* read survives outside the typed registry
+    (the env-registry rule enforces this for lodestar_tpu/, tools/ and
+    bench.py; this asserts the registry itself is importable and
+    non-trivial so the rule has teeth)."""
+    from lodestar_tpu.utils.env import REGISTRY
+
+    assert len(REGISTRY) >= 25
+    assert all(k.startswith("LODESTAR_TPU_") for k in REGISTRY)
+    types = {v.type for v in REGISTRY.values()}
+    assert types <= {"str", "int", "float", "bool"}

@@ -11,7 +11,7 @@ import pytest
 
 from lodestar_tpu import native
 from lodestar_tpu.bls import api as bls
-from lodestar_tpu.bls.curve import PointG1, PointG2, g1_to_bytes, g2_to_bytes
+from lodestar_tpu.bls.curve import g2_to_bytes
 from lodestar_tpu.bls.hash_to_curve import DST_G2, hash_to_g2
 from lodestar_tpu.ops.io_host import g1_affine_to_limbs, g2_affine_to_limbs
 

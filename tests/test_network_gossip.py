@@ -6,7 +6,6 @@ import hashlib
 
 import pytest
 
-from lodestar_tpu import native
 from lodestar_tpu.bls import api as bls
 from lodestar_tpu.chain import BeaconChain
 from lodestar_tpu.chain.validation import (

@@ -20,7 +20,7 @@ import pytest
 from lodestar_tpu.bls import api as bls
 from lodestar_tpu import native
 from lodestar_tpu.metrics import create_beacon_metrics
-from lodestar_tpu.observability.bench_emit import BenchEmitter, PhaseTimeout
+from lodestar_tpu.observability.bench_emit import BenchEmitter
 from lodestar_tpu.observability.stages import PipelineMetrics
 
 needs_native = pytest.mark.skipif(

@@ -5,7 +5,6 @@ import random
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from lodestar_tpu.bls.fields import P
 from lodestar_tpu.ops import fp

@@ -90,7 +90,6 @@ def test_perf_gossip_attestation_validation(runner):
         validate_gossip_attestation,
     )
     from lodestar_tpu.chain.bls_verifier import MockBlsVerifier
-    from lodestar_tpu.chain import BeaconChain
     from lodestar_tpu.params.presets import MINIMAL
     from tests.test_network_gossip import _make_single_attestation
     from tests.test_network_live import _fresh_chain

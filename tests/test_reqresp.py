@@ -75,7 +75,7 @@ def test_handlers_against_live_chain(tmp_path):
     from lodestar_tpu.params.presets import MINIMAL
     from lodestar_tpu.state_transition import interop_genesis_state
     from lodestar_tpu.types import get_types
-    from tests.test_chain import _attest_head, _sign_block, _sk
+    from tests.test_chain import _sign_block, _sk
     from lodestar_tpu.state_transition.block import _epoch_signing_root
     from lodestar_tpu.params import DOMAIN_RANDAO
     from lodestar_tpu.state_transition import process_slots

@@ -11,7 +11,6 @@ from lodestar_tpu.ssz import (
     Bytes32,
     ByteListType,
     Container,
-    ContainerType,
     DeserializationError,
     ListType,
     UnionType,

@@ -532,6 +532,53 @@ def test_fault_spec_parsing_and_unknown_mode():
         faults.configure("segfault")
 
 
+def test_fault_spec_malformed_param_is_a_clean_error():
+    """`chip:abc` must raise a ValueError naming the mode and parameter —
+    not a bare float() traceback — so the /debug/faults 400 body (and a
+    drill operator's terminal) says what to fix."""
+    with pytest.raises(ValueError, match="'chip'.*'abc' is not a number"):
+        faults.configure("chip:abc")
+    with pytest.raises(ValueError, match="'latency'.*not a number"):
+        faults.configure("latency:fast")
+    assert not faults.active()  # a rejected spec must not half-arm
+
+
+def test_fault_spec_rejects_negative_and_fractional_chip():
+    with pytest.raises(ValueError, match="must be >= 0"):
+        faults.configure("latency:-1")
+    with pytest.raises(ValueError, match="integer chip index"):
+        faults.configure("chip:1.5")
+    assert not faults.active()
+
+
+def test_fault_spec_combined_modes_and_blank_parts():
+    """Every mode in one spec, defaults applied when `:param` is omitted,
+    stray commas/whitespace tolerated."""
+    doc = faults.configure(" exception , latency:0.2 ,, chip:1 , flaky ")
+    try:
+        assert doc["modes"] == {
+            "exception": 1.0,  # default probability
+            "latency": 0.2,
+            "chip": 1.0,
+            "flaky": 1.0,      # default probability
+        }
+    finally:
+        faults.clear(reset_counters=True)
+
+
+def test_clear_keeps_counters_unless_reset_requested():
+    """A bare clear() disarms but keeps injection counters (a degraded
+    bench round stays self-labelled); reset_counters=True zeroes them."""
+    faults.configure("exception")
+    with pytest.raises(faults.InjectedFault):
+        faults.on_device_dispatch(1)
+    faults.clear()
+    assert not faults.active()
+    assert faults.snapshot()["injected"]["exception"] >= 1
+    faults.clear(reset_counters=True)
+    assert faults.snapshot()["injected"] == {}
+
+
 # --- /debug/breaker and /debug/faults ----------------------------------------
 
 
@@ -576,6 +623,21 @@ def test_debug_breaker_and_faults_endpoints():
         with urllib.request.urlopen(f"{url}/debug/faults?clear=1") as r:
             assert json.load(r)["active"] is False
         assert not faults.active()
+
+        # counters survive a bare clear (degraded runs stay labelled);
+        # ?clear=1&reset_counters=1 is the drill-teardown full reset
+        faults.configure("exception")
+        with pytest.raises(faults.InjectedFault):
+            faults.on_device_dispatch(1)
+        with urllib.request.urlopen(f"{url}/debug/faults?clear=1") as r:
+            doc = json.load(r)
+        assert doc["active"] is False and doc["injected"]["exception"] >= 1
+        req = urllib.request.Request(
+            f"{url}/debug/faults?clear=1&reset_counters=1", method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            doc = json.load(r)
+        assert doc["active"] is False and doc["injected"] == {}
     finally:
         server.close()
 

@@ -10,7 +10,6 @@ import asyncio
 import pytest
 
 from lodestar_tpu.network.transport import (
-    HandshakeError,
     NodeIdentity,
     Transport,
     peer_id_from_pubkey,

@@ -113,9 +113,27 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
             continue
         try:
             rec = json.load(open(path))
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — "
+                f"unreadable round file ({e})"
+            )
+            continue
+        if not isinstance(rec, dict):
+            # a `null` / truncated / list-shaped round file: carries no
+            # rows, but must be a visible skip and never a traceback
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — round "
+                f"file is not a JSON object ({type(rec).__name__})"
+            )
             continue
         parsed = rec.get("parsed") or {}
+        if not isinstance(parsed, dict):
+            print(
+                f"bench_compare: skipping r{int(m.group(1)):02d} — "
+                f"`parsed` is not a JSON object ({type(parsed).__name__})"
+            )
+            continue
         if not parsed:
             # `parsed: null` — the harness died before emitting; the
             # round carries no comparable rows but its absence from the
@@ -246,10 +264,17 @@ def main(argv=None) -> int:
     details = args.details or os.path.join(args.dir, "bench_details.json")
     history = load_history(args.dir, details_path=details)
     if len(history) < 2:
-        print(
-            f"bench_compare: {len(history)} parseable round(s) in "
-            f"{args.dir} — nothing to gate against"
-        )
+        if not history:
+            print(
+                f"bench_compare: no parseable bench history in {args.dir} "
+                "— nothing to gate against (a fresh checkout or an "
+                "all-degraded history is not a failure)"
+            )
+        else:
+            print(
+                f"bench_compare: 1 parseable round in {args.dir} — "
+                "nothing to gate against"
+            )
         return 0
     prev, curr = history[-2], history[-1]
     report, regressions = compare(prev, curr, args.threshold)

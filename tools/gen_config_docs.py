@@ -1,0 +1,97 @@
+"""Generate docs/configuration.md from the typed env-var registry.
+
+`lodestar_tpu/utils/env.py` is the single source of truth for every
+``LODESTAR_TPU_*`` knob (name / type / default / one-line doc); this tool
+renders it as a markdown table so operators never read source to learn a
+knob exists. The table is DRIFT-CHECKED in tier-1
+(tests/test_lint.py::test_config_docs_not_stale): adding or changing a
+registry entry without regenerating fails the default suite.
+
+    python tools/gen_config_docs.py            # rewrite docs/configuration.md
+    python tools/gen_config_docs.py --check    # exit 1 if the doc is stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "configuration.md")
+
+HEADER = """\
+# Configuration
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source: lodestar_tpu/utils/env.py (the typed env-var registry).
+     Regenerate with: python tools/gen_config_docs.py
+     Drift-checked in tier-1: tests/test_lint.py::test_config_docs_not_stale -->
+
+Every environment knob the node, bench harness and tools read. All reads
+go through `lodestar_tpu/utils/env.py` (enforced by the graftlint
+`env-registry` rule — see docs/architecture.md, "Enforced invariants");
+booleans treat `0 / off / false / no` and the empty string as false,
+numeric knobs fall back to their default on unparseable values.
+"""
+
+
+def _fmt_default(var) -> str:
+    if var.default is None:
+        return "_(unset)_"
+    if var.type == "bool":
+        return "on" if var.default else "off"
+    if isinstance(var.default, float) and var.default == int(var.default):
+        return str(int(var.default))
+    return f"`{var.default}`" if isinstance(var.default, str) else str(var.default)
+
+
+def render() -> str:
+    sys.path.insert(0, REPO_ROOT)
+    from lodestar_tpu.utils.env import REGISTRY
+
+    lines = [HEADER]
+    lines.append("| Name | Type | Default | Description |")
+    lines.append("| --- | --- | --- | --- |")
+    for name in sorted(REGISTRY):
+        var = REGISTRY[name]
+        lines.append(
+            f"| `{var.name}` | {var.type} | {_fmt_default(var)} | {var.doc} |"
+        )
+    lines.append("")
+    lines.append(f"{len(REGISTRY)} variables registered.")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if docs/configuration.md is stale instead "
+                         "of rewriting it")
+    ap.add_argument("--out", default=DOC_PATH)
+    args = ap.parse_args(argv)
+
+    content = render()
+    if args.check:
+        try:
+            current = open(args.out).read()
+        except OSError:
+            current = ""
+        if current != content:
+            print(
+                f"STALE: {args.out} does not match the env registry — "
+                "regenerate with `python tools/gen_config_docs.py`"
+            )
+            return 1
+        print(f"OK: {args.out} matches the env registry")
+        return 0
+    with open(args.out, "w") as f:
+        f.write(content)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,6 @@ jax.config.update(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"),
 )
 import jax.numpy as jnp
-import numpy as np
 
 from __graft_entry__ import _example_arrays
 from lodestar_tpu.ops import fp, fp12

@@ -1,0 +1,56 @@
+"""graftlint — AST-based invariant checker for this repo.
+
+Five rules, one AST walk per file (see core.py for the framework and the
+suppression syntax):
+
+* ``trace-safety``      — no host syncs / Python branching in kernels
+* ``lock-discipline``   — guarded-by contracts, no blocking under locks
+* ``env-registry``      — LODESTAR_TPU_* reads go through utils/env.py
+* ``exception-hygiene`` — no bare/silent broad exception handlers
+* ``metric-discipline`` — code and metric registry agree on families
+
+Run it: ``python -m tools.lint [paths…] [--json] [--rules r1,r2]``.
+Enforced in tier-1 by tests/test_lint.py (zero findings over
+lodestar_tpu/, tools/, bench.py, __graft_entry__.py — and every rule
+must fire on its planted-violation fixture).
+"""
+
+from __future__ import annotations
+
+from .checks_env import EnvRegistryChecker
+from .checks_exceptions import ExceptionHygieneChecker
+from .checks_locks import LockDisciplineChecker
+from .checks_metrics import MetricDisciplineChecker
+from .checks_trace import TraceSafetyChecker
+from .core import DEFAULT_PATHS, Checker, Context, Finding, Module, render, run
+
+ALL_CHECKER_CLASSES = (
+    TraceSafetyChecker,
+    LockDisciplineChecker,
+    EnvRegistryChecker,
+    ExceptionHygieneChecker,
+    MetricDisciplineChecker,
+)
+
+
+def all_checkers() -> list[Checker]:
+    """Fresh checker instances (checkers hold per-run state)."""
+    return [cls() for cls in ALL_CHECKER_CLASSES]
+
+
+def rule_names() -> list[str]:
+    return [cls.name for cls in ALL_CHECKER_CLASSES]
+
+
+__all__ = [
+    "ALL_CHECKER_CLASSES",
+    "Checker",
+    "Context",
+    "DEFAULT_PATHS",
+    "Finding",
+    "Module",
+    "all_checkers",
+    "render",
+    "rule_names",
+    "run",
+]

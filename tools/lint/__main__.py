@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.lint [paths…] [--json] [--rules r1,r2]``.
+
+Exits 1 when there are findings (tier-1 wires this through
+tests/test_lint.py), 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import ALL_CHECKER_CLASSES, render, rule_names, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: AST-based invariant checker "
+                    "(trace-safety, lock-discipline, env-registry, "
+                    "exception-hygiene, metric-discipline)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: "
+                         "lodestar_tpu tools bench.py __graft_entry__.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the available rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_CHECKER_CLASSES:
+            print(f"{cls.name}: {cls.description}")
+        return 0
+
+    checkers = None
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(rule_names())
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                  f"(available: {', '.join(rule_names())})", file=sys.stderr)
+            return 2
+        checkers = [cls() for cls in ALL_CHECKER_CLASSES if cls.name in wanted]
+
+    findings = run(paths=args.paths or None, checkers=checkers)
+    print(render(findings, as_json=args.json))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""env-registry: every LODESTAR_TPU_* read goes through utils/env.py.
+
+The typed registry (lodestar_tpu/utils/env.py) is the single source of
+truth for knob names, types, defaults and docs — docs/configuration.md
+is generated from it. A raw ``os.getenv("LODESTAR_TPU_…")`` bypasses the
+type contract and the generated docs, so it is a finding anywhere except
+inside the registry module itself. Environment *writes* stay legal (the
+probes and test harnesses set knobs for child processes).
+
+The rule also checks the other direction: a literal name passed to the
+typed accessors (``env_str`` / ``env_int`` / ``env_float`` / ``env_bool``
+/ ``raw`` / ``is_set``) must exist in the registry — a typo'd knob name
+otherwise silently reads the default forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Context, call_name, dotted_name
+
+_PREFIX = "LODESTAR_TPU_"
+_ACCESSORS = ("env_str", "env_int", "env_float", "env_bool", "raw", "is_set")
+# the registry module itself (and its tests) may touch os.environ
+_EXEMPT_SUFFIXES = ("utils/env.py",)
+
+
+def _registry_names() -> set[str] | None:
+    """The registered knob names, or None when the package can't be
+    imported from here (path-scoped run outside the repo)."""
+    try:
+        from lodestar_tpu.utils.env import REGISTRY
+
+        return set(REGISTRY)
+    except Exception:  # graftlint: disable=exception-hygiene — degrade to prefix-only checking rather than crash the linter
+        return None
+
+
+class EnvRegistryChecker(Checker):
+    name = "env-registry"
+    description = (
+        "LODESTAR_TPU_* reads must go through lodestar_tpu/utils/env.py; "
+        "names passed to the typed accessors must be registered"
+    )
+
+    def __init__(self):
+        self._registry = _registry_names()
+
+    def _exempt(self, ctx: Context) -> bool:
+        mod = ctx.module
+        return mod is not None and mod.rel_path.endswith(_EXEMPT_SUFFIXES)
+
+    @staticmethod
+    def _lodestar_literal(node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith(_PREFIX)
+        ):
+            return node.value
+        return None
+
+    def visit_Call(self, node: ast.Call, ctx: Context) -> None:
+        if self._exempt(ctx):
+            return
+        name = call_name(node) or ""
+        short = name.rsplit(".", 1)[-1]
+        arg0 = self._lodestar_literal(node.args[0]) if node.args else None
+
+        # raw reads: os.getenv(...) / os.environ.get(...) / getenv(...)
+        # (environ writes — assignment, pop, setdefault — stay legal: the
+        # probes and harnesses configure knobs for child processes)
+        is_raw_read = short == "getenv" or (
+            short == "get" and "environ" in name
+        )
+        if is_raw_read and arg0 is not None:
+            ctx.report(
+                self.name, node,
+                f"raw environment read of {arg0!r}; use the typed accessor "
+                "from lodestar_tpu/utils/env.py so the knob stays in the "
+                "registry and docs/configuration.md",
+            )
+            return
+
+        # typed-accessor reads: the literal must be a registered knob
+        if short in _ACCESSORS and arg0 is not None and self._registry is not None:
+            if arg0 not in self._registry:
+                ctx.report(
+                    self.name, node,
+                    f"{arg0!r} is not registered in lodestar_tpu/utils/"
+                    "env.py — register it (with type, default and doc) "
+                    "and regenerate docs/configuration.md",
+                )
+
+    def visit_Subscript(self, node: ast.Subscript, ctx: Context) -> None:
+        if self._exempt(ctx):
+            return
+        # os.environ["LODESTAR_TPU_X"] in Load/Del context (writes allowed)
+        if isinstance(node.ctx, ast.Store):
+            return
+        base = dotted_name(node.value) or ""
+        if "environ" not in base:
+            return
+        lit = self._lodestar_literal(node.slice)
+        if lit is not None:
+            ctx.report(
+                self.name, node,
+                f"raw os.environ[{lit!r}] read; use the typed accessor "
+                "from lodestar_tpu/utils/env.py",
+            )

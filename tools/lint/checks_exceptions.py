@@ -1,0 +1,61 @@
+"""exception-hygiene: no bare `except:`, no silently-swallowed Exception.
+
+A bare ``except:`` catches SystemExit/KeyboardInterrupt and turns Ctrl-C
+into a hang; ``except Exception: pass`` hides real faults (the
+fault-injection harness exists precisely because swallowed device errors
+looked like liveness bugs). Handlers that *do something* — log, count a
+metric, return a fallback, re-raise — are fine; only handlers whose body
+is pure no-op (``pass`` / ``...`` / ``continue`` / ``break`` / a bare
+constant) are findings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Context
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node: ast.AST) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    description = (
+        "no bare `except:`; broad `except Exception` handlers must act "
+        "(log, count, return, re-raise) rather than silently pass"
+    )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler, ctx: Context) -> None:
+        if node.type is None:
+            ctx.report(
+                self.name, node,
+                "bare `except:` also catches SystemExit/KeyboardInterrupt; "
+                "catch Exception (or something narrower) instead",
+            )
+            return
+        if _is_broad(node.type) and _is_silent(node.body):
+            ctx.report(
+                self.name, node,
+                "broad exception handler silently swallows the error; log "
+                "it, count it, or narrow the exception type "
+                "(`# graftlint: disable=exception-hygiene` with a reason "
+                "if intentional)",
+            )

@@ -1,0 +1,217 @@
+"""lock-discipline: guarded attributes stay under their lock; no
+blocking calls while a lock is held.
+
+Attributes annotated ``# guarded-by: _lock`` on their initialising
+assignment (``self._entries = {} # guarded-by: _lock``) may only be
+written inside ``with self._lock:`` in every other method — the
+annotation turns the class's implicit locking convention into a checked
+contract. ``__init__`` is exempt (no concurrent access before the
+constructor returns).
+
+Independently, a ``with <lock>:`` block must not park the thread:
+``time.sleep``, zero-argument ``.join()``, and ``.wait()`` with no (or
+``None``) timeout are findings — a blocked lock-holder stalls every
+other thread at the acquire site (exactly the pipeline-wide stall the
+dispatch-deadline work in chain/supervisor.py exists to prevent).
+Condition-variable receivers (``cond`` / ``cv`` / ``condition``) are
+exempt from the ``.wait()`` rule: Condition.wait releases the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Checker, Context, dotted_name
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_COND_HINTS = ("cond", "cv", "condition")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_names_in_with(node: ast.With) -> list[str]:
+    """Receiver names of with-items that look like plain locks
+    (`self._lock`, `lock`, `self._pk_lock`, …) — not condition vars."""
+    names = []
+    for item in node.items:
+        expr = item.context_expr
+        # unwrap `with self._lock:` vs `with self._lock.acquire_timeout(..)`
+        name = dotted_name(expr) or (
+            dotted_name(expr.func) if isinstance(expr, ast.Call) else None
+        )
+        if not name:
+            continue
+        leaf = name.rsplit(".", 1)[-1].lower()
+        if "lock" in leaf and not any(h in leaf for h in _COND_HINTS):
+            names.append(name.rsplit(".", 1)[-1])
+    return names
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    description = (
+        "`# guarded-by: <lock>` attributes only written under that lock; "
+        "no time.sleep / untimed .wait() / .join() while a lock is held"
+    )
+
+    # --- guarded-by contract (whole-class analysis in end_module) -------
+
+    def end_module(self, module, ctx: Context) -> None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(node, module, ctx)
+
+    def _check_class(self, cls: ast.ClassDef, module, ctx: Context) -> None:
+        guarded: dict[str, str] = {}  # attr -> lock attr name
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                m = _GUARDED_RE.search(module.line_comment(node.lineno))
+                if not m:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    attr = _self_attr(target)
+                    if attr:
+                        guarded[attr] = m.group(1)
+        if not guarded:
+            return
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue  # no concurrent access before the ctor returns
+            if item.name.endswith("_locked"):
+                continue  # repo convention: the caller holds the lock
+            self._check_method(item, guarded, module, ctx)
+
+    def _check_method(self, func, guarded: dict[str, str], module,
+                      ctx: Context) -> None:
+        self._walk_writes(func.body, guarded, held=set(), module=module,
+                          ctx=ctx)
+
+    def _walk_writes(self, stmts, guarded, held: set[str], module,
+                     ctx: Context) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                inner = held | {
+                    n for n in _lock_names_in_with(stmt) if n in guarded.values()
+                }
+                self._walk_writes(stmt.body, guarded, inner, module, ctx)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs later, not under the current lock;
+                # treat its body as lock-free
+                self._walk_writes(stmt.body, guarded, set(), module, ctx)
+                continue
+            if isinstance(stmt, (ast.If,)):
+                self._walk_writes(stmt.body, guarded, held, module, ctx)
+                self._walk_writes(stmt.orelse, guarded, held, module, ctx)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                self._walk_writes(stmt.body, guarded, held, module, ctx)
+                self._walk_writes(stmt.orelse, guarded, held, module, ctx)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_writes(stmt.body, guarded, held, module, ctx)
+                for handler in stmt.handlers:
+                    self._walk_writes(handler.body, guarded, held, module, ctx)
+                self._walk_writes(stmt.orelse, guarded, held, module, ctx)
+                self._walk_writes(stmt.finalbody, guarded, held, module, ctx)
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    flat = []
+                    for target in targets:
+                        if isinstance(target, (ast.Tuple, ast.List)):
+                            flat.extend(target.elts)
+                        else:
+                            flat.append(target)
+                    for target in flat:
+                        attr = _self_attr(target)
+                        if attr in guarded and guarded[attr] not in held:
+                            ctx.report(
+                                self.name, node,
+                                f"`self.{attr}` is annotated `# guarded-by: "
+                                f"{guarded[attr]}` but is written without "
+                                f"holding `self.{guarded[attr]}`",
+                                module=module,
+                            )
+
+    # --- blocking-while-locked (shared single walk) ---------------------
+
+    def visit_With(self, node: ast.With, ctx: Context) -> None:
+        if not _lock_names_in_with(node):
+            return
+        self._scan_blocking(node.body, ctx)
+
+    def _scan_blocking(self, stmts, ctx: Context) -> None:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.With) and _lock_names_in_with(node):
+                    continue  # nested with reported by its own visit
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if name in ("time.sleep", "sleep") and node.args:
+                    ctx.report(
+                        self.name, node,
+                        "time.sleep while holding a lock stalls every "
+                        "thread blocked on the acquire",
+                    )
+                elif leaf == "join" and not node.args and not node.keywords:
+                    receiver = (
+                        dotted_name(node.func.value) or ""
+                        if isinstance(node.func, ast.Attribute) else ""
+                    )
+                    # str.join takes an iterable arg; a 0-arg join is a
+                    # thread/process join — unbounded while locked
+                    ctx.report(
+                        self.name, node,
+                        f"unbounded {receiver or 'thread'}.join() while "
+                        "holding a lock; join outside the lock or use a "
+                        "timeout",
+                    )
+                elif leaf == "wait":
+                    receiver = (
+                        (dotted_name(node.func.value) or "").lower()
+                        if isinstance(node.func, ast.Attribute) else ""
+                    )
+                    if any(h in receiver for h in _COND_HINTS):
+                        continue  # Condition.wait releases the lock
+                    timeout = None
+                    if node.args:
+                        timeout = node.args[0]
+                    for kw in node.keywords:
+                        if kw.arg in ("timeout", "timeout_s"):
+                            timeout = kw.value
+                    unbounded = timeout is None or (
+                        isinstance(timeout, ast.Constant)
+                        and timeout.value is None
+                    )
+                    if unbounded:
+                        ctx.report(
+                            self.name, node,
+                            "untimed .wait() while holding a lock can "
+                            "block forever; pass a timeout or wait "
+                            "outside the lock",
+                        )

@@ -1,0 +1,267 @@
+"""metric-discipline: code and registry agree on metric families+labels.
+
+Declarations are ``registry.counter/gauge/histogram/summary/gauge_func(
+"lodestar_…", help, label_names)`` calls. The rule enforces, across the
+whole linted tree (cross-file state, emitted in ``finalize``):
+
+* a family declared twice with different label sets is a finding (the
+  exporter would emit conflicting series);
+* every *other* full-string ``lodestar_*`` literal in code (dashboards
+  checks, alert text, tests of the export path) must resolve to a
+  declared family — ``_bucket`` / ``_sum`` / ``_count`` suffixes resolve
+  to their histogram/summary base;
+* a call on a bound metric attribute (``m.batches.inc(…)``) must pass
+  exactly the declared label names as keywords — a missing or extra
+  label raises at runtime, on the error path where nobody is looking;
+* a declared family whose bound attribute is never touched again and
+  which no dashboard plots is dead weight: it exports a flat zero
+  forever (``gauge_func`` is exempt — the callback IS the use).
+
+Cross-checks only run when the linted paths contained declarations, so
+path-scoped runs over a leaf directory don't misreport unknown families.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Checker, Context
+
+_DECL_KINDS = ("counter", "gauge", "histogram", "summary", "gauge_func")
+_USE_METHODS = ("inc", "observe", "set", "time")
+# methods whose name is too generic to infer "this receiver is a metric"
+# unless label kwargs are present
+_GENERIC_METHODS = ("set", "time")
+_FAMILY_RE = re.compile(r"lodestar_[a-z][a-z0-9_]*")
+_EXPORT_SUFFIXES = ("_bucket", "_sum", "_count")
+_STAR = "**"
+
+
+def _state(ctx: Context) -> dict:
+    return ctx.state.setdefault(
+        "metric-discipline",
+        {"declared": {}, "usages": [], "attr_uses": [], "attr_mentions": {}},
+    )
+
+
+def _literal_labels(node: ast.AST | None):
+    """Tuple of label names for a literal tuple/list of strings, () for
+    None/missing, None when the expression isn't statically known."""
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.Constant) and node.value in ((), None):
+        return ()
+    return None
+
+
+class MetricDisciplineChecker(Checker):
+    name = "metric-discipline"
+    description = (
+        "lodestar_* names in code must exist in the registry (and vice "
+        "versa) with consistent label sets"
+    )
+
+    def visit_Call(self, node: ast.Call, ctx: Context) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        state = _state(ctx)
+        if func.attr in _DECL_KINDS:
+            self._record_declaration(node, func, state, ctx)
+        elif func.attr in _USE_METHODS and isinstance(func.value, ast.Attribute):
+            attr = func.value.attr
+            if any(kw.arg is None for kw in node.keywords):
+                labels = _STAR  # **labels — not statically checkable
+            else:
+                labels = tuple(sorted(kw.arg for kw in node.keywords))
+            state["attr_uses"].append(
+                (attr, func.attr, labels, ctx.module, node.lineno,
+                 node.col_offset)
+            )
+
+    def _record_declaration(self, node, func, state, ctx: Context) -> None:
+        if not node.args:
+            return
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant) and isinstance(arg0.value, str)):
+            return
+        family = arg0.value
+        if not _FAMILY_RE.fullmatch(family):
+            return
+        kind = func.attr
+        if kind == "gauge_func":
+            labels = ()
+        else:
+            label_arg = None
+            if len(node.args) > 2:
+                label_arg = node.args[2]
+            for kw in node.keywords:
+                if kw.arg == "label_names":
+                    label_arg = kw.value
+            labels = _literal_labels(label_arg)
+        bound_attr = None
+        parent = ctx.parent()
+        if isinstance(parent, ast.Assign) and parent.value is node:
+            for target in parent.targets:
+                if isinstance(target, ast.Attribute):
+                    bound_attr = target.attr
+        prior = state["declared"].get(family)
+        if prior is not None:
+            if (
+                labels is not None
+                and prior["labels"] is not None
+                and tuple(sorted(labels)) != tuple(sorted(prior["labels"]))
+            ):
+                ctx.report(
+                    self.name, node,
+                    f"metric family {family!r} redeclared with labels "
+                    f"{sorted(labels)} but first declared at "
+                    f"{prior['where']} with {sorted(prior['labels'])}",
+                )
+            if bound_attr:
+                prior["attrs"].add(bound_attr)
+            return
+        state["declared"][family] = {
+            "labels": labels,
+            "kind": kind,
+            "attrs": {bound_attr} if bound_attr else set(),
+            "where": f"{ctx.module.rel_path}:{node.lineno}"
+            if ctx.module else "?",
+            "module": ctx.module,
+            "line": node.lineno,
+        }
+
+    def visit_Constant(self, node: ast.Constant, ctx: Context) -> None:
+        if not isinstance(node.value, str):
+            return
+        if not _FAMILY_RE.fullmatch(node.value):
+            return
+        if node.value.startswith("lodestar_tpu"):
+            return  # the package name, dashboards file names, etc.
+        parent = ctx.parent()
+        if isinstance(parent, ast.Expr):
+            return  # docstring
+        if (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Attribute)
+            and parent.func.attr in _DECL_KINDS
+            and parent.args
+            and parent.args[0] is node
+        ):
+            return  # the declaration itself
+        _state(ctx)["usages"].append(
+            (node.value, ctx.module, node.lineno, node.col_offset)
+        )
+
+    def visit_Attribute(self, node: ast.Attribute, ctx: Context) -> None:
+        mentions = _state(ctx)["attr_mentions"]
+        mentions[node.attr] = mentions.get(node.attr, 0) + 1
+
+    # --- cross-file resolution -------------------------------------------
+
+    def finalize(self, ctx: Context) -> None:
+        state = _state(ctx)
+        declared = state["declared"]
+        if not declared:
+            return  # path-scoped run without the registry modules
+
+        for literal, module, line, col in state["usages"]:
+            if literal in declared:
+                continue
+            base = None
+            for suffix in _EXPORT_SUFFIXES:
+                if literal.endswith(suffix):
+                    base = literal[: -len(suffix)]
+                    break
+            if base is not None and base in declared:
+                continue
+            ctx.report(
+                self.name, line,
+                f"{literal!r} does not match any declared metric family "
+                "(registry declarations are the source of truth; fix the "
+                "name or declare the metric)",
+                module=module, col=col,
+            )
+
+        # attr -> unique declared label set (skip ambiguous attr names)
+        attr_labels: dict[str, tuple] = {}
+        for family, info in declared.items():
+            if info["labels"] is None:
+                continue
+            for attr in info["attrs"]:
+                key = tuple(sorted(info["labels"]))
+                if attr in attr_labels and attr_labels[attr] != key:
+                    attr_labels[attr] = None  # ambiguous across families
+                else:
+                    attr_labels.setdefault(attr, key)
+        for attr, method, labels, module, line, col in state["attr_uses"]:
+            expected = attr_labels.get(attr)
+            if expected is None or labels == _STAR:
+                continue
+            if method in _GENERIC_METHODS and not labels:
+                # bare .set(v)/.time(): receiver names are too generic to
+                # be sure this is a metric, so only keyword mismatches
+                # (clear evidence of intent) are findings
+                continue
+            if labels != expected:
+                ctx.report(
+                    self.name, line,
+                    f".{method}() on metric attribute `{attr}` passes "
+                    f"labels {list(labels)} but the declaration expects "
+                    f"{list(expected)}",
+                    module=module, col=col,
+                )
+
+        dashboards_text = self._dashboards_text()
+        for family, info in declared.items():
+            if info["kind"] == "gauge_func":
+                continue
+            literal_used = any(
+                u[0] == family
+                or any(u[0] == family + s for s in _EXPORT_SUFFIXES)
+                for u in state["usages"]
+            )
+            attr_used = any(
+                state["attr_mentions"].get(a, 0) > 1 for a in info["attrs"]
+            )
+            if literal_used or attr_used:
+                continue
+            if family in dashboards_text:
+                continue
+            ctx.report(
+                self.name, info["line"],
+                f"metric family {family!r} is declared but its handle is "
+                "never used and no dashboard plots it — it will export a "
+                "flat zero forever; wire it up or remove it",
+                module=info["module"],
+            )
+
+    @staticmethod
+    def _dashboards_text() -> str:
+        from .core import REPO_ROOT
+
+        chunks = []
+        dash_dir = os.path.join(REPO_ROOT, "dashboards")
+        try:
+            names = sorted(os.listdir(dash_dir))
+        except OSError:
+            return ""
+        for name in names:
+            if name.endswith(".json"):
+                try:
+                    with open(os.path.join(dash_dir, name),
+                              encoding="utf-8") as f:
+                        chunks.append(f.read())
+                except OSError:
+                    continue
+        return "\n".join(chunks)

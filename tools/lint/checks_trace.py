@@ -1,0 +1,300 @@
+"""trace-safety: no host round-trips or Python branching inside kernels.
+
+A "kernel" is any function that runs under a JAX trace: decorated with
+``jax.jit`` (directly or via ``functools.partial(jax.jit, …)``), passed
+to ``jit`` / ``shard_map`` / ``_shard_map`` / ``vmap`` / ``pmap`` /
+``lax.scan``-family wrappers, or (transitively) any same-module function
+called from a kernel body. Inside a kernel:
+
+* ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` /
+  ``jax.device_get`` force a device sync — under ``jit`` they fail at
+  trace time or silently break; under interpret-mode they "work" and
+  then explode on the TPU path (the exact class of bug the dryrun
+  multichip check exists to catch early).
+* ``np.asarray`` / ``np.array`` / ``np.frombuffer`` on a traced value
+  pulls it to host — constants must use ``jnp.asarray`` (legal: it
+  stages a device constant).
+* ``float(x)`` / ``bool(x)`` on a traced value raise
+  ``ConcretizationTypeError`` at trace time (shape/ndim/dtype/len
+  arguments are static and exempt).
+* ``if``/``while`` whose test calls ``jnp.*`` / ``lax.*`` branches on a
+  traced value — use ``jnp.where`` / ``lax.cond``.
+
+Separately, call sites of functions jitted with ``static_argnums`` /
+``static_argnames`` must pass hashable values in static positions —
+a list/set/dict/ndarray there recompiles every call or raises.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, Context, dotted_name
+
+_JIT_LEAVES = ("jit", "pjit")
+_WRAPPER_LEAVES = (
+    "jit", "pjit", "shard_map", "_shard_map", "vmap", "pmap",
+    "scan", "fori_loop", "while_loop", "cond", "switch", "checkpoint",
+    "remat", "custom_jvp", "custom_vjp", "grad", "value_and_grad",
+)
+_NP_ROOTS = ("np", "numpy", "onp")
+_HOST_PULL_ATTRS = ("item", "tolist", "block_until_ready")
+_STATIC_SHAPE_HINTS = ("shape", "ndim", "dtype", "size", "len")
+
+
+def _leaf(name: str | None) -> str:
+    return (name or "").rsplit(".", 1)[-1]
+
+
+def _parse_static_kwargs(keywords) -> tuple[set[int], set[str]]:
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums |= {
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                }
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names |= {
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+    return nums, names
+
+
+def _jit_decoration(dec: ast.AST):
+    """(is_jit, static_argnums, static_argnames) for a decorator node."""
+    if _leaf(dotted_name(dec)) in _JIT_LEAVES:
+        return True, set(), set()
+    if isinstance(dec, ast.Call):
+        leaf = _leaf(dotted_name(dec.func))
+        if leaf in _JIT_LEAVES:
+            return (True, *_parse_static_kwargs(dec.keywords))
+        if leaf == "partial" and dec.args:
+            if _leaf(dotted_name(dec.args[0])) in _JIT_LEAVES:
+                return (True, *_parse_static_kwargs(dec.keywords))
+    return False, set(), set()
+
+
+class TraceSafetyChecker(Checker):
+    name = "trace-safety"
+    description = (
+        "no host syncs (.item/np.asarray/device_get/float()) or Python "
+        "branching on traced values inside jitted/shard_map'd kernels; "
+        "static_argnums call sites must pass hashable values"
+    )
+
+    def end_module(self, module, ctx: Context) -> None:
+        tree = module.tree
+        defs: dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+
+        kernels: set[str] = set()
+        # static-call contracts: callable name -> (argnum set, argname set)
+        static_sigs: dict[str, tuple[set[int], set[str]]] = {}
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    is_jit, nums, names = _jit_decoration(dec)
+                    if is_jit:
+                        kernels.add(node.name)
+                        if nums or names:
+                            static_sigs[node.name] = (nums, names)
+            elif isinstance(node, ast.Call):
+                leaf = _leaf(dotted_name(node.func))
+                if leaf in _WRAPPER_LEAVES:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in defs:
+                            kernels.add(arg.id)
+
+        # `g = jax.jit(f, static_argnums=…)` binds the contract to `g`
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if _leaf(dotted_name(call.func)) in _JIT_LEAVES:
+                    nums, names = _parse_static_kwargs(call.keywords)
+                    if nums or names:
+                        for target in node.targets:
+                            if isinstance(target, ast.Name):
+                                static_sigs[target.id] = (nums, names)
+
+        # transitive closure: same-module functions called from kernels
+        # run under the same trace
+        changed = True
+        while changed:
+            changed = False
+            for kname in list(kernels):
+                fn = defs.get(kname)
+                if fn is None:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        callee = node.func
+                        if (
+                            isinstance(callee, ast.Name)
+                            and callee.id in defs
+                            and callee.id not in kernels
+                        ):
+                            kernels.add(callee.id)
+                            changed = True
+
+        for kname in kernels:
+            fn = defs.get(kname)
+            if fn is not None:
+                self._check_kernel_body(fn, module, ctx)
+
+        if static_sigs:
+            self._check_static_call_sites(tree, static_sigs, module, ctx)
+
+    # --- host-sync and branching checks inside a kernel body ------------
+
+    def _check_kernel_body(self, fn, module, ctx: Context) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._check_call(node, fn, module, ctx)
+            elif isinstance(node, (ast.If, ast.While)):
+                self._check_branch(node, fn, module, ctx)
+
+    def _check_call(self, node: ast.Call, fn, module, ctx: Context) -> None:
+        name = dotted_name(node.func) or ""
+        leaf = _leaf(name)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_PULL_ATTRS
+        ):
+            ctx.report(
+                self.name, node,
+                f"`.{node.func.attr}()` inside kernel `{fn.name}` forces a host "
+                "sync on a traced value; compute on-device "
+                "(jnp.where/lax ops) and sync outside the kernel",
+                module=module,
+            )
+            return
+        root = name.split(".", 1)[0]
+        if root in _NP_ROOTS and leaf in ("asarray", "array", "frombuffer"):
+            ctx.report(
+                self.name, node,
+                f"`{name}` inside kernel `{fn.name}` pulls the operand to "
+                "host; use `jnp.asarray` for constants, jnp ops for "
+                "traced values",
+                module=module,
+            )
+            return
+        if leaf == "device_get" and root in ("jax", "device_get"):
+            ctx.report(
+                self.name, node,
+                f"`jax.device_get` inside kernel `{fn.name}` forces a "
+                "device->host transfer under trace",
+                module=module,
+            )
+            return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "bool")
+            and len(node.args) == 1
+            and not node.keywords
+        ):
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                return
+            if self._is_static_shape_expr(arg):
+                return
+            ctx.report(
+                self.name, node,
+                f"`{node.func.id}(…)` on a traced value inside kernel "
+                f"`{fn.name}` raises ConcretizationTypeError at trace "
+                "time; use jnp casts (`.astype`) or keep the value traced",
+                module=module,
+            )
+
+    @staticmethod
+    def _is_static_shape_expr(expr: ast.AST) -> bool:
+        """shape/ndim/dtype/len() expressions are static under trace."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_SHAPE_HINTS:
+                return True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+            ):
+                return True
+        return False
+
+    def _check_branch(self, node, fn, module, ctx: Context) -> None:
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call):
+                root = (dotted_name(sub.func) or "").split(".", 1)[0]
+                if root in ("jnp", "lax"):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    ctx.report(
+                        self.name, node,
+                        f"Python `{kind}` on a `{root}.*` value inside "
+                        f"kernel `{fn.name}` branches on a traced value; "
+                        "use jnp.where or lax.cond",
+                        module=module,
+                    )
+                    return
+
+    # --- static_argnums call-site hashability ----------------------------
+
+    @staticmethod
+    def _unhashable(arg: ast.AST) -> str | None:
+        if isinstance(arg, ast.List):
+            return "list"
+        if isinstance(arg, ast.Set):
+            return "set"
+        if isinstance(arg, ast.Dict):
+            return "dict"
+        if isinstance(arg, ast.Call):
+            name = dotted_name(arg.func) or ""
+            if _leaf(name) in ("array", "asarray", "zeros", "ones") and \
+                    name.split(".", 1)[0] in _NP_ROOTS + ("jnp",):
+                return "ndarray"
+        return None
+
+    def _check_static_call_sites(self, tree, static_sigs, module,
+                                 ctx: Context) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            if fname not in static_sigs:
+                continue
+            nums, names = static_sigs[fname]
+            for i, arg in enumerate(node.args):
+                if i in nums:
+                    kind = self._unhashable(arg)
+                    if kind:
+                        ctx.report(
+                            self.name, arg,
+                            f"unhashable {kind} passed in static position "
+                            f"{i} of jitted `{fname}` — static args are "
+                            "cache keys; pass a tuple/scalar",
+                        )
+            for kw in node.keywords:
+                if kw.arg in names:
+                    kind = self._unhashable(kw.value)
+                    if kind:
+                        ctx.report(
+                            self.name, kw.value,
+                            f"unhashable {kind} passed for static arg "
+                            f"`{kw.arg}` of jitted `{fname}` — static "
+                            "args are cache keys; pass a tuple/scalar",
+                        )

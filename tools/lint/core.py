@@ -1,0 +1,272 @@
+"""graftlint core: one AST walk per file, pluggable checkers, suppressions.
+
+The framework parses each file once, walks the tree once (maintaining the
+ancestor stack), and fans every node out to the checkers that registered
+a handler for its type (``visit_Call``, ``visit_If``, …). Checkers that
+need whole-module structure (class layouts, jit closures) get
+``begin_module`` / ``end_module`` with the parsed tree; checkers that
+need cross-file state (the metric registry lives in one module, the
+increments in many) accumulate into ``ctx.state`` and emit from
+``finalize``.
+
+Suppressions: a ``# graftlint: disable=<rule>[,<rule>…]`` comment on the
+line a finding anchors to silences it (``disable=all`` silences every
+rule on that line); ``# graftlint: disable-file=<rule>`` anywhere in the
+file silences the rule file-wide. Suppressions are parsed from real
+comment tokens, not substring matches, so string literals cannot
+accidentally disable a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*(disable|disable-file)\s*=\s*([a-z0-9_,\s-]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+class Module:
+    """One parsed source file plus its suppression tables."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # {lineno: set of rule names (or "all")} and file-wide rule names
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+                if m.group(1) == "disable-file":
+                    self.file_suppressions |= rules
+                else:
+                    self.line_suppressions.setdefault(
+                        tok.start[0], set()
+                    ).update(rules)
+        except tokenize.TokenError:
+            pass  # graftlint: disable=exception-hygiene — unparseable tail; the AST parse above already vouched for the file
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        rules = self.line_suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    def line_comment(self, line: int) -> str:
+        """The text of `line` (1-based), '' when out of range — checkers
+        use this for structured annotations like `# guarded-by: _lock`."""
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Context:
+    """Shared walk state: the current module, the ancestor stack, the
+    findings sink, and a cross-file scratch dict keyed by checker."""
+
+    def __init__(self):
+        self.module: Module | None = None
+        self.stack: list[ast.AST] = []
+        self.findings: list[Finding] = []
+        self.state: dict[str, object] = {}
+
+    def parent(self, up: int = 1) -> ast.AST | None:
+        return self.stack[-up] if len(self.stack) >= up else None
+
+    def report(self, rule: str, node: ast.AST | int, message: str,
+               module: Module | None = None, col: int | None = None) -> None:
+        mod = module or self.module
+        if isinstance(node, int):
+            line, column = node, col or 0
+        else:
+            line = getattr(node, "lineno", 0)
+            column = getattr(node, "col_offset", 0) if col is None else col
+        if mod is not None and mod.suppressed(rule, line):
+            return
+        self.findings.append(
+            Finding(mod.rel_path if mod else "?", line, column, rule, message)
+        )
+
+
+class Checker:
+    """Base class. Subclasses set `name`/`description`, implement any of
+    `visit_<NodeType>`, `begin_module`, `end_module`, `finalize`."""
+
+    name = "abstract"
+    description = ""
+
+    def begin_module(self, module: Module, ctx: Context) -> None:
+        pass
+
+    def end_module(self, module: Module, ctx: Context) -> None:
+        pass
+
+    def finalize(self, ctx: Context) -> None:
+        pass
+
+    def handlers(self) -> dict[type, callable]:
+        table: dict[type, callable] = {}
+        for attr in dir(self):
+            if attr.startswith("visit_"):
+                node_type = getattr(ast, attr[len("visit_"):], None)
+                if node_type is not None:
+                    table[node_type] = getattr(self, attr)
+        return table
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` for Name/Attribute chains, None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+class _Walker:
+    """Single-pass dispatcher: every node visited exactly once, handlers
+    looked up by concrete node type."""
+
+    def __init__(self, checkers: list[Checker], ctx: Context):
+        self.ctx = ctx
+        self.dispatch: dict[type, list[callable]] = {}
+        for checker in checkers:
+            for node_type, handler in checker.handlers().items():
+                self.dispatch.setdefault(node_type, []).append(handler)
+
+    def walk(self, tree: ast.AST) -> None:
+        self._visit(tree)
+
+    def _visit(self, node: ast.AST) -> None:
+        for handler in self.dispatch.get(type(node), ()):
+            handler(node, self.ctx)
+        self.ctx.stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+        self.ctx.stack.pop()
+
+
+DEFAULT_PATHS = ("lodestar_tpu", "tools", "bench.py", "__graft_entry__.py")
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+
+def iter_py_files(paths) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                )
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames)
+                    if f.endswith(".py")
+                )
+        elif path.endswith(".py") and os.path.exists(path):
+            out.append(path)
+    return out
+
+
+def run(paths=None, checkers=None, root: str | None = None) -> list[Finding]:
+    """Lint `paths` (files or directories) with `checkers` (default: all
+    registered rules); returns findings sorted by location."""
+    from . import all_checkers
+
+    root = root or os.getcwd()
+    if paths is None:
+        paths = [p for p in DEFAULT_PATHS if os.path.exists(os.path.join(root, p))]
+    active = checkers if checkers is not None else all_checkers()
+    ctx = Context()
+    modules: list[Module] = []
+    for file_path in iter_py_files(
+        [p if os.path.isabs(p) else os.path.join(root, p) for p in paths]
+    ):
+        rel = os.path.relpath(file_path, root).replace(os.sep, "/")
+        try:
+            with open(file_path, encoding="utf-8") as f:
+                source = f.read()
+            module = Module(file_path, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            ctx.findings.append(
+                Finding(rel, getattr(e, "lineno", 0) or 0, 0, "parse-error",
+                        f"could not parse: {e}")
+            )
+            continue
+        modules.append(module)
+        ctx.module = module
+        walker = _Walker(active, ctx)
+        for checker in active:
+            checker.begin_module(module, ctx)
+        walker.walk(module.tree)
+        for checker in active:
+            checker.end_module(module, ctx)
+    ctx.module = None
+    for checker in active:
+        checker.finalize(ctx)
+    ctx.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return ctx.findings
+
+
+def render(findings: list[Finding], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(
+            {"findings": [f.as_dict() for f in findings],
+             "count": len(findings)},
+            indent=2,
+        )
+    if not findings:
+        return "graftlint: no findings"
+    lines = [f.human() for f in findings]
+    lines.append(f"graftlint: {len(findings)} finding(s)")
+    return "\n".join(lines)

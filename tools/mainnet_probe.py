@@ -48,7 +48,6 @@ jax.config.update(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".jax_cache"),
 )
 
-import numpy as np
 
 N_VALIDATORS = int(os.environ.get("MAINNET_PROBE_VALIDATORS", "1000000"))
 SLOTS = int(os.environ.get("MAINNET_PROBE_SLOTS", "8"))

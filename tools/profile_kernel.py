@@ -45,7 +45,7 @@ def main():
         miller_loop_projective,
     )
     from lodestar_tpu.ops.points import g1, g2
-    from lodestar_tpu.parallel.verifier import N_LIMBS, R_BITS
+    from lodestar_tpu.parallel.verifier import N_LIMBS
     from __graft_entry__ import _example_arrays
 
     print(f"batch={BATCH} reps={REPS} device={jax.devices()[0]}")

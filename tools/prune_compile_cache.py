@@ -36,16 +36,12 @@ DEFAULT_CACHE_DIR = os.path.abspath(
 
 def default_limit_gb() -> float:
     """The configured bound: LODESTAR_TPU_CACHE_LIMIT_GB, else 2 GiB."""
-    raw = os.environ.get(ENV_LIMIT)
-    if raw:
-        try:
-            return float(raw)
-        except ValueError:
-            print(
-                f"prune_compile_cache: ignoring bad {ENV_LIMIT}={raw!r}",
-                file=sys.stderr,
-            )
-    return DEFAULT_LIMIT_GB
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    from lodestar_tpu.utils.env import env_float
+
+    return env_float(ENV_LIMIT)
 
 
 def scan(cache_dir: str) -> list[tuple[float, int, str]]:

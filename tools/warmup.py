@@ -79,7 +79,7 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
         _example_grouped,
         _example_pk_grouped,
     )
-    from lodestar_tpu.parallel.verifier import BatchVerifier, SetArrays, _rand_pairs
+    from lodestar_tpu.parallel.verifier import BatchVerifier, SetArrays
 
     buckets = (4, 16, 64, 128) + ((4096,) if include_bench else ())
     grouped = ((16, 8), (64, 64)) + (
@@ -196,9 +196,9 @@ def main() -> None:
         return
     # mirror the runtime default: raw kernels ON unless explicitly off
     # (an explicit --device-decompress wins over the env off-switch)
-    env_off = os.environ.get(
-        "LODESTAR_TPU_DEVICE_DECOMPRESS", "1"
-    ).lower() in ("0", "off", "false")
+    from lodestar_tpu.utils.env import env_bool
+
+    env_off = not env_bool("LODESTAR_TPU_DEVICE_DECOMPRESS")
     device_decompress = args.device_decompress or not (
         args.no_device_decompress or env_off
     )
