@@ -16,6 +16,12 @@ profiler + lifecycle-trace control surface:
                           ?set=<spec> arms it, ?clear=1 disarms — the
                           live chaos-drill control surface
                           (docs/robustness.md)
+    GET /debug/compiles   compile-ledger snapshot (every compile event:
+                          kernel, shape key, duration, cache hit/miss,
+                          cumulative seconds), the startup timeline
+                          (serving-ready SLO marks), and the flight-
+                          recorder ring (?limit=K recent events)
+                          (observability/compile_ledger.py)
 
 (GET also accepted on the profiler routes — operator curl ergonomics.)
 The profiler hooks default to `observability.trace`, the same process-
@@ -173,6 +179,25 @@ class MetricsServer:
                         self._send_json(400, {"error": str(e)})
                         return
                     self._send_json(200, doc)
+                    return
+                if route == "/debug/compiles":
+                    from ..observability import compile_ledger, flight_recorder
+
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        limit = min(int((q.get("limit") or [64])[0]), 256)
+                    except ValueError:
+                        self._send_json(400, {"error": "bad limit"})
+                        return
+                    self._send_json(
+                        200,
+                        {
+                            "ledger": compile_ledger.ledger().snapshot(),
+                            "startup": compile_ledger.timeline().snapshot(),
+                            "flight_recorder":
+                                flight_recorder.recorder().dump(limit=limit),
+                        },
+                    )
                     return
                 if route not in ("", "/metrics"):
                     self.send_response(404)

@@ -55,6 +55,11 @@ class BeaconNode:
         self.config = config
         self.types = types
         self.log = get_logger("node")
+        # cold-start timeline: marks are seconds since PROCESS start, so
+        # interpreter+import time counts toward the serving-ready SLO
+        from ..observability.compile_ledger import timeline
+
+        timeline().mark("node_init")
 
         # 1. db
         if opts.db_controller is not None:
@@ -126,6 +131,7 @@ class BeaconNode:
             verifier = ThreadBufferedVerifier(
                 self.bls_supervisor, prom=self.metrics,
             )
+            timeline().mark("verifier_ready")
         else:
             self.bls_supervisor = None
             verifier = CpuBlsVerifier()
@@ -189,6 +195,18 @@ class BeaconNode:
             self.log.info("metrics on :%d", self.metrics_server.port)
 
         self.notifier = NodeNotifier(self, opts.notifier_interval_slots)
+
+        # runtime identity on /metrics (lodestar_tpu_build_info) + the
+        # serving-ready SLO mark: init returning IS this node's ready
+        # point. Device enumeration only when the device tier is on — a
+        # CPU-only node must not pay backend init just to label a gauge.
+        from ..utils.jax_env import runtime_info
+
+        self.metrics.pipeline.set_build_info(
+            runtime_info(enumerate_devices=opts.tpu_verifier)
+        )
+        ready_s = timeline().mark_serving_ready()
+        self.log.info("serving-ready %.2fs after process start", ready_s)
         return self
 
     def attach_network(self, network) -> None:

@@ -24,6 +24,15 @@ threaded through the verifier stack:
   validation, BLS verify, fork choice and head update; ring-buffer
   retention served by the metrics server's `/debug/traces`; slot-
   milestone delay metrics.
+- `compile_ledger` — process-wide XLA compile accounting: every compile
+  at the jit/shard_map seams is a measured event (kernel, signature,
+  duration, persistent-cache hit/miss) feeding the
+  `lodestar_tpu_compile_*` families, `/debug/compiles`, and the
+  per-run `compile_ledger.json` artifact; plus the startup timeline
+  whose `serving_ready_seconds` gauge is the cold-start SLO.
+- `flight_recorder` — bounded black-box ring of dispatch/compile/
+  breaker/mesh/phase events, dumped into every bench emission (watchdog
+  and SIGTERM paths included) so an rc=124 round leaves a post-mortem.
 """
 
 from .stages import (  # noqa: F401
@@ -41,6 +50,13 @@ from .trace import (  # noqa: F401
     stop_profiling,
 )
 from .bench_emit import BenchEmitter, PhaseTimeout  # noqa: F401
+from .compile_ledger import (  # noqa: F401
+    CompileLedger,
+    StartupTimeline,
+    ledger,
+    timeline,
+)
+from .flight_recorder import FlightRecorder, recorder  # noqa: F401
 from .spans import (  # noqa: F401
     MILESTONES,
     Tracer,

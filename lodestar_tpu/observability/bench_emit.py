@@ -23,6 +23,11 @@ The document's final stdout line is a single JSON object carrying the
 headline metric plus per-phase throughput, the stage-time breakdown, and
 planner-decision counts (sections are registered as callables and read at
 emit time, so a mid-run kill still reports everything observed so far).
+Every emission also carries a `flight_recorder` section — the black-box
+ring of recent dispatch/compile/breaker/mesh/phase events — so a
+watchdog or SIGTERM flush names WHAT the run was doing when it died, and
+`on_emit` hooks run inside emit() (even on the watchdog path, which
+skips atexit) for per-run artifacts like compile_ledger.json.
 
 Deliberately import-light (stdlib only): the emitter must work even when
 jax fails to initialize — that failure is itself a reportable result.
@@ -41,6 +46,16 @@ import time
 
 class PhaseTimeout(Exception):
     """Raised inside a phase body when its deadline expires."""
+
+
+def _flight(kind: str, **fields) -> None:
+    """Drop one event into the black-box flight recorder; a stripped-down
+    standalone copy of this module (no package siblings) stays usable."""
+    try:
+        from .flight_recorder import record
+    except ImportError:
+        return
+    record(kind, **fields)
 
 
 class _Phase:
@@ -71,6 +86,7 @@ class _PhaseContext:
         self._em.phases[self._name] = rec
         self._rec = rec
         self._t0 = time.monotonic()
+        _flight("bench_phase", phase=self._name, status="start")
         if self._deadline is not None and self._deadline > 0:
             try:  # SIGALRM only works on the main thread
                 def _expire(signum, frame):
@@ -88,18 +104,22 @@ class _PhaseContext:
             signal.setitimer(signal.ITIMER_REAL, 0.0)
             signal.signal(signal.SIGALRM, self._prev_handler)
         self._rec["seconds"] = round(time.monotonic() - self._t0, 3)
-        if exc_type is None:
-            self._rec["status"] = "ok"
-            return False
-        if issubclass(exc_type, PhaseTimeout):
-            self._rec["status"] = "timeout"
-            return True  # graceful skip: later phases still run
-        if issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
-            self._rec["status"] = "interrupted"
-            return False  # propagate; atexit/SIGTERM emit the partial doc
-        self._rec["status"] = "error"
-        self._rec["error"] = f"{exc_type.__name__}: {exc}"
-        return True  # graceful skip
+        try:
+            if exc_type is None:
+                self._rec["status"] = "ok"
+                return False
+            if issubclass(exc_type, PhaseTimeout):
+                self._rec["status"] = "timeout"
+                return True  # graceful skip: later phases still run
+            if issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+                self._rec["status"] = "interrupted"
+                return False  # propagate; atexit/SIGTERM emit the partial doc
+            self._rec["status"] = "error"
+            self._rec["error"] = f"{exc_type.__name__}: {exc}"
+            return True  # graceful skip
+        finally:
+            _flight("bench_phase", phase=self._name,
+                    status=self._rec["status"], seconds=self._rec["seconds"])
 
 
 class BenchEmitter:
@@ -130,10 +150,26 @@ class BenchEmitter:
         self.stream = stream if stream is not None else sys.stdout
         self.phases: dict[str, dict] = {}
         self.extra: dict = {}
+        # zero-arg-or-doc callables run inside emit() after the details
+        # file is written — the hook for per-run artifacts (e.g. the
+        # compile ledger's compile_ledger.json) that must ALSO land on
+        # the watchdog path, where os._exit(124) skips atexit
+        self.on_emit: list = []
         self._sections: dict[str, object] = {}
         self._headline: float | None = None
         self._emitted = False
         self._lock = threading.Lock()
+        # the black-box post-mortem rides every emission (including the
+        # watchdog/SIGTERM partial flush): the last N flight-recorder
+        # events name the exact kernel/phase a killed run wedged on
+        try:
+            from .flight_recorder import recorder as _recorder
+
+            self._sections.setdefault(
+                "flight_recorder", lambda: _recorder().dump(limit=64)
+            )
+        except ImportError:
+            pass  # standalone copy without package siblings
         atexit.register(self._emit_atexit)
         self._install_sigterm()
         if global_deadline_s is not None and global_deadline_s > 0:
@@ -206,6 +242,11 @@ class BenchEmitter:
                     json.dump(doc, f, indent=2)
             except OSError as e:
                 print(f"bench: details write failed: {e}", file=sys.stderr)
+        for hook in list(self.on_emit):
+            try:
+                hook(doc)
+            except Exception as e:  # an artifact hook must not block emission
+                print(f"bench: emit hook failed: {e}", file=sys.stderr)
         print(json.dumps(doc), file=self.stream, flush=True)
         return doc
 
@@ -230,6 +271,7 @@ class BenchEmitter:
         # timed-out round instead of treating its partial rates as a trend
         self.extra["timed_out"] = True
         self.extra["watchdog_fired_after_s"] = budget_s
+        _flight("watchdog_fired", budget_s=budget_s)
         self.emit()
         os._exit(124)
 
@@ -243,6 +285,7 @@ class BenchEmitter:
                     if rec["status"] == "running":
                         rec["status"] = "killed"
                 self.extra["timed_out"] = True
+                _flight("sigterm")
                 self.emit()
                 if callable(prev):
                     prev(signum, frame)

@@ -1,0 +1,106 @@
+"""Black-box flight recorder: a bounded ring of recent pipeline events.
+
+Both red driver rounds to date (BENCH_r05 `rc: 124, parsed: null` and the
+round-4 MULTICHIP cold-cache kill) died without naming WHAT they were
+doing when the clock ran out. This module is the crash-survivable answer:
+every interesting transition — kernel dispatch, compile start/end,
+breaker flip, mesh eviction, bench-phase boundary, warmup rung — drops a
+tiny dict into a process-wide `collections.deque(maxlen=N)`. The bench
+emitter reads the ring at EMIT time (including the watchdog and SIGTERM
+paths), so an rc=124 round's final JSON carries a post-mortem naming the
+exact kernel/shape/phase it wedged on instead of a bare `timed_out`
+marker.
+
+Design constraints (mirrors `bench_emit`): stdlib-only, import-light,
+never raises into the hot path. A `record()` is one lock + one deque
+append — cheap enough for per-batch dispatch events. The ring size is
+LODESTAR_TPU_FLIGHT_RECORDER_SIZE (default 256 events); `dump()` reports
+how many older events were dropped so a truncated history is visible,
+never silent.
+
+Event shape: {"seq", "t_s", "kind", ...kind-specific fields}. `t_s` is
+seconds since the recorder singleton was created (≈ process start for
+the bench/warmup/node entrypoints, which all touch observability early).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["FlightRecorder", "recorder", "record"]
+
+DEFAULT_CAPACITY = 256
+
+
+def _configured_capacity() -> int:
+    from ..utils.env import env_int
+
+    size = env_int("LODESTAR_TPU_FLIGHT_RECORDER_SIZE")
+    return size if size and size > 0 else DEFAULT_CAPACITY
+
+
+class FlightRecorder:
+    """Bounded ring of recent events; thread-safe; drop-oldest."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = _configured_capacity()
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=max(1, int(capacity)))  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._t0 = time.monotonic()
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one event; returns it (tests assert on the shape)."""
+        t_s = round(time.monotonic() - self._t0, 3)
+        with self._lock:
+            self._seq += 1
+            event = {"seq": self._seq, "t_s": t_s, "kind": kind, **fields}
+            self._ring.append(event)
+        return event
+
+    def dump(self, limit: int | None = None) -> dict:
+        """Snapshot for the bench doc / `/debug/compiles`: newest-last
+        events plus enough bookkeeping to see what the ring dropped."""
+        with self._lock:
+            events = list(self._ring)
+            total = self._seq
+        if limit is not None and limit >= 0:
+            events = events[-limit:]
+        return {
+            "capacity": self._ring.maxlen,
+            "recorded_total": total,
+            "dropped": total - len(events),
+            "events": events,
+        }
+
+
+_recorder: FlightRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide ring every subsystem records into."""
+    global _recorder
+    with _recorder_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder
+
+
+def record(kind: str, **fields) -> dict:
+    """Module-level convenience: `flight_recorder.record("breaker", ...)`."""
+    return recorder().record(kind, **fields)
+
+
+def _reset_for_tests() -> None:
+    """Drop the singleton so a test gets a fresh, empty ring."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None

@@ -61,7 +61,9 @@ def profile_stages(
     limb, bits, raw = _rand_inputs(batch)
 
     def timed(stage: str, fn, *args):
-        jitted = jax.jit(fn)
+        from .compile_ledger import ledger
+
+        jitted = ledger().wrap(jax.jit(fn), f"stage_{stage}")
         with annotation(f"stage_profile/{stage}/compile"):
             out = jitted(*args)
             jax.block_until_ready(out)

@@ -30,6 +30,14 @@ import threading
 import time
 
 from ..metrics.registry import MetricsRegistry
+from . import flight_recorder
+
+# label set of the build-info gauge (one constant-1 series whose labels
+# carry the runtime identity — utils/jax_env.runtime_info produces it)
+BUILD_INFO_LABELS = (
+    "jax", "jaxlib", "backend", "device_kind", "device_count",
+    "mesh_divisor", "compile_cache",
+)
 
 STAGES = (
     "marshal",
@@ -251,11 +259,63 @@ class PipelineMetrics:
             "sharded kernel dispatches per participating chip",
             label_names=("chip",),
         )
+        # compile-ledger / cold-start families (round 11): compilation is
+        # the tax that killed both red driver rounds — these make every
+        # compile event and the getting-to-serving path first-class
+        # metrics (observability/compile_ledger.py feeds them)
+        self.compile_events = r.counter(
+            "lodestar_tpu_compile_events_total",
+            "XLA compile events recorded by the compile ledger, by kernel "
+            "and persistent-cache outcome (hit/miss/off)",
+            label_names=("kernel", "cache"),
+        )
+        self.compile_seconds = r.counter(
+            "lodestar_tpu_compile_seconds_total",
+            "wall seconds spent in first-dispatch kernel compiles",
+            label_names=("kernel",),
+        )
+        self.compile_cumulative = r.gauge(
+            "lodestar_tpu_compile_cumulative_seconds",
+            "cumulative compile seconds this process (ledger total)",
+        )
+        self.compile_cache_entries = r.gauge(
+            "lodestar_tpu_compile_cache_entries",
+            "entries in the persistent XLA compile cache at the last prune",
+        )
+        self.compile_cache_pruned = r.counter(
+            "lodestar_tpu_compile_cache_pruned_bytes_total",
+            "bytes the LRU pruner removed from the persistent compile cache",
+        )
+        self.serving_ready_gauge = r.gauge(
+            "lodestar_tpu_serving_ready_seconds",
+            "seconds from process start to serving-ready (cold-start SLO; "
+            "measured cold vs warm .jax_cache — docs/architecture.md)",
+        )
+        self.startup_phase_seconds = r.gauge(
+            "lodestar_tpu_startup_phase_seconds",
+            "seconds from process start to each startup-phase mark "
+            "(devices ready, warmup rungs, serving ready)",
+            label_names=("phase",),
+        )
+        self.build_info = r.gauge(
+            "lodestar_tpu_build_info",
+            "constant 1; labels carry the runtime identity (jax/jaxlib "
+            "version, backend, device kind/count, mesh divisor, "
+            "compile-cache dir set/unset)",
+            label_names=BUILD_INFO_LABELS,
+        )
         # device-busy sampler state: busy seconds accumulate per resolve,
         # the fraction is re-sampled over >=1 s wall windows
         self._busy_lock = threading.Lock()
         self._busy_accum = 0.0
         self._busy_window_t0 = time.monotonic()
+        # the process-wide compile ledger fans its events out to every
+        # live pipeline: the node registry and the bench/tools default
+        # pipeline both see the same compile history (weakref — a
+        # discarded test registry detaches itself)
+        from .compile_ledger import ledger as _compile_ledger
+
+        _compile_ledger().attach(self)
 
     # -- stage timers -------------------------------------------------------
 
@@ -273,6 +333,7 @@ class PipelineMetrics:
         if group_sizes:
             for size in group_sizes:
                 self.planner_group_size.observe(size)
+        flight_recorder.record("dispatch", path=path, sets=n_sets)
 
     def cache_event(self, cache: str, hit: bool, n: int = 1) -> None:
         if n:
@@ -299,15 +360,18 @@ class PipelineMetrics:
         self.supervisor_breaker_state.set(value)
         if to is not None:
             self.supervisor_transitions.inc(to=to)
+            flight_recorder.record("breaker", to=to, state=value)
 
     def supervisor_retry(self) -> None:
         self.supervisor_retries.inc()
 
     def supervisor_fallback(self, reason: str, n_sets: int = 0) -> None:
         self.supervisor_fallbacks.inc(reason=reason)
+        flight_recorder.record("fallback", reason=reason, sets=n_sets)
 
     def supervisor_deadline(self) -> None:
         self.supervisor_deadline_exceeded.inc()
+        flight_recorder.record("deadline_exceeded")
 
     def supervisor_canary_probe(self, ok: bool) -> None:
         self.supervisor_canary.inc(outcome="ok" if ok else "fail")
@@ -330,15 +394,49 @@ class PipelineMetrics:
 
     def mesh_eviction(self, chip: int, reason: str) -> None:
         self.mesh_evictions.inc(reason=reason)
+        flight_recorder.record("mesh_eviction", chip=chip, reason=reason)
 
     def mesh_readmission(self, n: int = 1) -> None:
         self.mesh_readmissions.inc(n)
+        flight_recorder.record("mesh_readmission", chips=n)
 
     def mesh_dispatch(self, chips) -> None:
         """Tick the per-chip dispatch counter for every participating chip
         of one sharded dispatch."""
         for chip in chips:
             self.mesh_dispatches.inc(chip=str(chip))
+
+    # -- compile ledger / cold start ----------------------------------------
+
+    def compile_event(self, kernel: str, cache: str, seconds: float,
+                      cumulative_s: float | None = None) -> None:
+        """One first-dispatch compile observed by the ledger (the ledger
+        fans this out to every live pipeline — don't call directly)."""
+        self.compile_events.inc(kernel=kernel, cache=cache)
+        self.compile_seconds.inc(seconds, kernel=kernel)
+        if cumulative_s is not None:
+            self.compile_cumulative.set(cumulative_s)
+
+    def cache_pruned(self, removed_bytes: int, entries_remaining: int) -> None:
+        """One compile-cache prune pass (tools/prune_compile_cache.py)."""
+        if removed_bytes:
+            self.compile_cache_pruned.inc(removed_bytes)
+        self.compile_cache_entries.set(entries_remaining)
+
+    def startup_phase(self, phase: str, seconds: float) -> None:
+        self.startup_phase_seconds.set(seconds, phase=phase)
+
+    def serving_ready(self, seconds: float) -> None:
+        self.serving_ready_gauge.set(seconds)
+
+    def set_build_info(self, info: dict) -> None:
+        """Export the runtime identity as the constant-1 build-info gauge
+        (missing keys render as "unknown" so a partial dict never throws
+        a label mismatch at startup)."""
+        labels = {
+            k: str(info.get(k, "unknown")) for k in BUILD_INFO_LABELS
+        }
+        self.build_info.set(1, **labels)
 
     # -- queue / flush ------------------------------------------------------
 
@@ -494,4 +592,12 @@ def default_pipeline() -> PipelineMetrics:
     with _default_lock:
         if _default is None:
             _default = PipelineMetrics()
+        return _default
+
+
+def peek_default() -> PipelineMetrics | None:
+    """The default pipeline IF one already exists — never creates one.
+    CLI tools (prune_compile_cache) use this so ticking a counter doesn't
+    spin up a registry in a process that never had one."""
+    with _default_lock:
         return _default

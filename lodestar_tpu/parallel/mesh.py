@@ -78,6 +78,27 @@ def _default_factory(kind: str, devices, axis: str):
     return cls(Mesh(np.array(devices), axis_names=(axis,)), axis)
 
 
+def _ledger_wrap_submit(v, kind: str, shape, chips) -> None:
+    """Route a freshly built sharded verifier's first `submit` through the
+    compile ledger: each (kind, shape, chip-set) verifier is exactly one
+    shard_map compile, so the static key encodes shape+chips — a
+    post-eviction mesh shrink recompiling on the serving path records a
+    NEW event (the ROADMAP item-5 restart-story cost, now measured).
+    Factory products without a rebindable `submit` (test stubs with
+    __slots__/properties) are left untouched."""
+    from ..observability.compile_ledger import ledger
+
+    try:
+        v.submit = ledger().wrap(
+            v.submit,
+            f"sharded_{kind}",
+            static_key=f"{tuple(shape)}@chips{','.join(str(c) for c in chips)}",
+        )
+    except AttributeError:
+        logger.debug("mesh: %s verifier submit not rebindable; compile "
+                     "ledger seam skipped", kind)
+
+
 class BlsMeshDispatcher:
     """Routes grouped/pk-grouped/bisect batches onto the serving mesh and
     owns the evict/re-admit state machine. Thread-safe: the supervisor's
@@ -127,6 +148,7 @@ class BlsMeshDispatcher:
                 v = self._factory(
                     kind, [self._devices[c] for c in chips], self.axis
                 )
+                _ledger_wrap_submit(v, kind, shape, chips)
                 self._verifiers[key] = v
             return v, chips
 

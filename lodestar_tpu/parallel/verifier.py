@@ -667,15 +667,26 @@ class BatchVerifier:
                 # the per-set kernel's bit-plane signature sums use
                 # subset-4 tables (ops/msm.py): lane counts must divide
                 raise ValueError("buckets must be multiples of 4")
-        self._batch = jax.jit(batch_verify_kernel)
-        self._individual = jax.jit(individual_verify_kernel)
-        self._grouped = jax.jit(grouped_verify_kernel)
-        self._batch_raw = jax.jit(batch_verify_kernel_raw)
-        self._grouped_raw = jax.jit(grouped_verify_kernel_raw)
-        self._pk_grouped = jax.jit(pk_grouped_verify_kernel)
-        self._pk_grouped_raw = jax.jit(pk_grouped_verify_kernel_raw)
-        self._bisect_tree = jax.jit(bisect_tree_kernel)
-        self._bisect_probe = jax.jit(bisect_probe_kernel)
+        # every jitted kernel goes through the compile ledger's wrap seam:
+        # the first dispatch per shape signature is timed and recorded as
+        # a compile event (kernel name, shape key, duration, persistent-
+        # cache hit/miss) — zero overhead after the first call
+        from ..observability.compile_ledger import ledger as _compile_ledger
+
+        _wrap = _compile_ledger().wrap
+        self._batch = _wrap(jax.jit(batch_verify_kernel), "batch")
+        self._individual = _wrap(jax.jit(individual_verify_kernel), "individual")
+        self._grouped = _wrap(jax.jit(grouped_verify_kernel), "grouped")
+        self._batch_raw = _wrap(jax.jit(batch_verify_kernel_raw), "batch_raw")
+        self._grouped_raw = _wrap(
+            jax.jit(grouped_verify_kernel_raw), "grouped_raw"
+        )
+        self._pk_grouped = _wrap(jax.jit(pk_grouped_verify_kernel), "pk_grouped")
+        self._pk_grouped_raw = _wrap(
+            jax.jit(pk_grouped_verify_kernel_raw), "pk_grouped_raw"
+        )
+        self._bisect_tree = _wrap(jax.jit(bisect_tree_kernel), "bisect_tree")
+        self._bisect_probe = _wrap(jax.jit(bisect_probe_kernel), "bisect_probe")
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:

@@ -127,6 +127,10 @@ _register("LODESTAR_TPU_TRACE_LIFECYCLE", "bool", True,
 _register("LODESTAR_TPU_PERSIST_INVALID", "str", None,
           "Directory to dump SSZ objects that failed import (debugging; "
           "unset = disabled).")
+_register("LODESTAR_TPU_FLIGHT_RECORDER_SIZE", "int", 256,
+          "Bounded event ring of the black-box flight recorder "
+          "(observability/flight_recorder.py); dumped into bench "
+          "documents and /debug/compiles.")
 
 # --- compile containment --------------------------------------------------
 _register("LODESTAR_TPU_COMPILE_CACHE", "str", None,

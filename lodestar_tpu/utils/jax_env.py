@@ -13,7 +13,60 @@ from __future__ import annotations
 import os
 import re
 
-__all__ = ["force_platform", "enable_compile_cache", "default_cache_dir"]
+__all__ = [
+    "force_platform", "enable_compile_cache", "default_cache_dir",
+    "runtime_info",
+]
+
+
+def runtime_info(enumerate_devices: bool = True) -> dict:
+    """The process runtime identity for the `lodestar_tpu_build_info`
+    gauge and the bench document's `runtime_info` block: jax/jaxlib
+    version, backend, device kind/count, mesh divisor, compile-cache dir.
+
+    `enumerate_devices=False` skips `jax.devices()` — backend
+    initialization is a process-global side effect a CPU-only node
+    (opts.tpu_verifier off) must not pay just to label a gauge. All
+    values are strings (they ride Prometheus labels)."""
+    info = {
+        "jax": "none",
+        "jaxlib": "none",
+        "backend": "none",
+        "device_kind": "none",
+        "device_count": "0",
+        "mesh_divisor": "0",
+        "compile_cache": "unset",
+    }
+    try:
+        import jax
+    except ImportError:
+        return info
+    info["jax"] = getattr(jax, "__version__", "unknown")
+    try:
+        import jaxlib
+
+        info["jaxlib"] = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:
+        pass
+    cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if cache:
+        info["compile_cache"] = cache
+    if not enumerate_devices:
+        return info
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return info  # backend init failed; the static identity still helps
+    info["backend"] = devices[0].platform
+    info["device_kind"] = getattr(
+        devices[0], "device_kind", devices[0].platform
+    )
+    info["device_count"] = str(len(devices))
+    # parallel.mesh is jax-free at import (unlike parallel.sharded)
+    from ..parallel.mesh import mesh_divisor
+
+    info["mesh_divisor"] = str(mesh_divisor(len(devices)))
+    return info
 
 
 def default_cache_dir() -> str:

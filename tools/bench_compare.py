@@ -35,7 +35,12 @@ Comparison rules:
   round but MISSING from the current one fails the run (a disappeared
   row hides regressions as effectively as a slow one);
 - fewer than two parseable rounds exits 0 with a note (nothing to gate
-  against), never a false red.
+  against), never a false red;
+- each round's cumulative XLA compile seconds (the bench document's
+  `compile_ledger` section) is printed as an INFORMATIONAL prev->curr
+  delta, never gated: compile time varies with cache warmth, and the
+  warm/cold distinction lives in the ledger itself — but a silent 10x
+  compile-cost growth should at least be visible in the report.
 
 Exit code: 0 = no regression, 1 = at least one gated key regressed (or a
 required key disappeared).
@@ -92,6 +97,21 @@ def _numeric_rows(doc: dict) -> dict[str, float]:
             if isinstance(value, (int, float)) and not isinstance(value, bool):
                 rows[str(key)] = float(value)
     return rows
+
+
+def _compile_seconds(doc) -> float | None:
+    """Cumulative XLA compile seconds from the document's compile-ledger
+    section (observability/compile_ledger.py), or None when the round
+    predates the ledger."""
+    if not isinstance(doc, dict):
+        return None
+    section = doc.get("compile_ledger")
+    if not isinstance(section, dict):
+        return None
+    value = section.get("cumulative_seconds")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    return None
 
 
 def _is_degraded(doc) -> bool:
@@ -163,7 +183,11 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
             continue
         rows = _numeric_rows(parsed)
         if rows:
-            rounds.append({"n": int(m.group(1)), "rows": rows})
+            rounds.append({
+                "n": int(m.group(1)),
+                "rows": rows,
+                "compile_s": _compile_seconds(parsed),
+            })
     rounds.sort(key=lambda r: r["n"])
     if rounds and details_path and os.path.exists(details_path):
         try:
@@ -180,6 +204,8 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
         # the round file's own headline
         for key, value in detail_rows.items():
             rounds[-1]["rows"].setdefault(key, value)
+        if rounds[-1].get("compile_s") is None and detail_rows:
+            rounds[-1]["compile_s"] = _compile_seconds(detail_doc)
     return rounds
 
 
@@ -290,6 +316,16 @@ def main(argv=None) -> int:
             f"(worse x{ratio:.2f})" if ratio > 1.0 else
             f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
             f"(better x{1 / ratio:.2f})"
+        )
+    pc, cc = prev.get("compile_s"), curr.get("compile_s")
+    if pc is not None or cc is not None:
+        def _fmt(v):
+            return f"{v:.1f}s" if v is not None else "n/a"
+
+        print(
+            f"  info        cumulative compile seconds {_fmt(pc)} -> "
+            f"{_fmt(cc)} (informational; not gated — varies with cache "
+            "warmth, see compile_ledger)"
         )
     if regressions:
         print(

@@ -59,6 +59,21 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_bls_mesh_evictions_total",
         "lodestar_bls_mesh_readmissions_total",
         "lodestar_bls_mesh_chip_dispatch_total",
+        # compile-ledger families (ISSUE 11): every XLA compile is a
+        # measured event — the compile tax that killed two driver rounds
+        # must be on the dashboard, not only in /debug/compiles
+        "lodestar_tpu_compile_events_total",
+        "lodestar_tpu_compile_seconds_total",
+        "lodestar_tpu_compile_cumulative_seconds",
+        "lodestar_tpu_compile_cache_entries",
+        "lodestar_tpu_compile_cache_pruned_bytes_total",
+    ),
+    # cold-start / runtime-identity families (ISSUE 11): the
+    # serving-ready SLO and build info belong on the fleet summary
+    "lodestar_tpu_summary.json": (
+        "lodestar_tpu_build_info",
+        "lodestar_tpu_serving_ready_seconds",
+        "lodestar_tpu_startup_phase_seconds",
     ),
 }
 

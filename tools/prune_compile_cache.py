@@ -24,6 +24,7 @@ restart stays), mtime is the portable fallback on noatime mounts.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -70,9 +71,12 @@ def prune(
 ) -> dict:
     """Delete least-recently-used entries until the cache fits the bound.
 
-    Returns {"entries", "total_bytes", "limit_bytes", "removed",
-    "removed_bytes"} — `removed` lists the pruned paths (would-be-pruned
-    under `dry_run`)."""
+    Returns {"entries", "entries_remaining", "total_bytes",
+    "limit_bytes", "removed", "removed_bytes"} — `removed` lists the
+    pruned paths (would-be-pruned under `dry_run`). A real (non-dry)
+    prune is observable: a structured `compile_cache_prune` log line on
+    stderr and a `note_prune` into the compile ledger (metrics when a
+    registry is live, artifact record always)."""
     if limit_gb is None:
         limit_gb = default_limit_gb()
     entries = scan(cache_dir)
@@ -92,13 +96,47 @@ def prune(
             total -= size
             if total <= limit:
                 break
-    return {
+    result = {
         "entries": len(entries),
+        "entries_remaining": len(entries) - len(removed),
         "total_bytes": total,
         "limit_bytes": limit,
         "removed": removed,
         "removed_bytes": removed_bytes,
     }
+    if not dry_run:
+        _observe(result)
+    return result
+
+
+def _observe(result: dict) -> None:
+    """Make the prune observable: one structured JSON log line on stderr
+    (always — grep-able even when nothing else is wired), plus the
+    compile ledger's `note_prune`, which persists the record into the
+    next `compile_ledger.json` artifact and ticks
+    `lodestar_tpu_compile_cache_pruned_bytes_total` /
+    `lodestar_tpu_compile_cache_entries` on every live metrics
+    pipeline."""
+    print(
+        json.dumps({
+            "event": "compile_cache_prune",
+            "entries": result["entries"],
+            "entries_remaining": result["entries_remaining"],
+            "removed": len(result["removed"]),
+            "removed_bytes": result["removed_bytes"],
+            "total_bytes": result["total_bytes"],
+        }),
+        file=sys.stderr,
+        flush=True,
+    )
+    try:
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+        )
+        from lodestar_tpu.observability.compile_ledger import ledger
+    except ImportError:
+        return  # standalone copy outside the repo tree
+    ledger().note_prune(result)
 
 
 def main(argv=None) -> int:

@@ -69,9 +69,11 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
     XLA executable). `device_decompress` (default-on, matching the
     runtime default) adds the *_raw kernel variants (on-chip signature
     decode) for every shape in the ladder."""
+    from lodestar_tpu.observability.compile_ledger import ledger, timeline
     from lodestar_tpu.utils.jax_env import enable_compile_cache
 
     enable_compile_cache(CACHE_DIR)
+    timeline().mark("warmup_start")
     import jax
 
     from __graft_entry__ import (
@@ -117,6 +119,7 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
             jax.block_until_ready(root_ok)
             print(f"bisect tree bucket {b}: {time.monotonic() - t0:.1f}s "
                   f"root_ok={bool(root_ok)}", flush=True)
+        timeline().mark(f"rung_bucket_{b}")
     # the fixed-shape bisection probe kernel (ONE compile total)
     import numpy as np
     from lodestar_tpu.ops import fp12 as _fp12
@@ -141,6 +144,7 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
             ok = bool(bv.verify_grouped_raw(g, sig_raw, a_bits, b_bits))
             print(f"grouped raw {rows}x{lanes}: {time.monotonic() - t0:.1f}s "
                   f"verdict={ok}", flush=True)
+        timeline().mark(f"rung_grouped_{rows}x{lanes}")
     for rows, lanes in pk_grouped:
         if device_decompress:
             g, a_bits, b_bits, sig_raw = _example_pk_grouped(
@@ -157,6 +161,15 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
             ok = bool(bv.verify_pk_grouped_raw(g, sig_raw, a_bits, b_bits))
             print(f"pk-grouped raw {rows}x{lanes}: "
                   f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
+        timeline().mark(f"rung_pk_grouped_{rows}x{lanes}")
+    # the ladder is the serving contract: every production shape compiled
+    # means a node restarting against this cache is serving-ready here
+    t_ready = timeline().mark_serving_ready()
+    print(f"warmup: serving-ready at {t_ready:.1f}s since process start "
+          f"({ledger().snapshot()['cumulative_seconds']:.1f}s in compiles)",
+          flush=True)
+    ledger().write_artifact(os.path.join(CACHE_DIR, "..",
+                                         "compile_ledger.json"))
 
 
 def warm_dryrun(n: int) -> None:
