@@ -85,23 +85,19 @@ def _bench_grouped(jax, lanes: int = GROUPED_LANES, utilization: bool = False):
 
 
 def _bench_worst_case(jax) -> dict:
-    """Two adversarial rows (VERDICT r4 #2):
+    """The adversarial row (VERDICT r4 #2):
 
     - `worst_case_unique`: an attacker floods unique AttestationData
       (roots never group) but signs with boundedly many keys — the
       planner routes the PK-GROUPED kernel (bilinearity on the pubkey
       axis: e(pk, Σ r_i·H_i); parallel/verifier
       pk_grouped_verify_kernel). 128 keys × 32 unique roots each.
-    - `floor_distinct_pk_and_msg`: distinct pubkeys AND roots
-      simultaneously (range-sync of distinct proposers' blocks — not an
-      adversary-scalable shape). Nothing groups; the per-set kernel's
-      rate is the unconditional floor."""
-    from __graft_entry__ import _example_arrays, _example_pk_grouped
+
+    The distinct-pk-and-msg floor row moved to the parity-gated
+    `floor_batched_fe` phase (ISSUE 14)."""
+    from __graft_entry__ import _example_pk_grouped
     from lodestar_tpu.observability.compile_ledger import ledger
-    from lodestar_tpu.parallel.verifier import (
-        batch_verify_kernel,
-        pk_grouped_verify_kernel,
-    )
+    from lodestar_tpu.parallel.verifier import pk_grouped_verify_kernel
 
     g, a_bits, b_bits = _example_pk_grouped(128, 32, unique_msgs=8)
     args = [
@@ -118,23 +114,78 @@ def _bench_worst_case(jax) -> dict:
         r = fn(*args)
     r.block_until_ready()
     dt = (time.perf_counter() - t0) / REPS
-    rows = {
+    return {
         "device_sets_per_sec_worst_case_unique": round(WORST_CASE_BATCH / dt, 2),
         "worst_case_unique_via": "pk_grouped_128x32",
     }
 
+
+def _bench_floor_batched_fe(jax) -> dict:
+    """The unconditional floor, parity-gated old-vs-new (ISSUE 14).
+
+    Shape: distinct pubkeys AND roots simultaneously (range-sync of
+    distinct proposers' blocks — not an adversary-scalable shape);
+    nothing groups, so the per-set kernel's rate is the floor.
+
+    Three rows:
+    - `device_sets_per_sec_floor_distinct_pk_and_msg` — the REQUIRED
+      floor key (binding moved here from `worst_case`), measured on the
+      production per-set kernel, whose verdict tail now runs the
+      shared-inversion batched final exp.
+    - `device_sets_per_sec_verdicts_batched_fe` / `_legacy_fe` — the
+      per-set VERDICT kernel (N per-lane final exps before ISSUE 14)
+      both ways on the same device arrays. The two verdict vectors must
+      be bit-identical and all-true or the phase dies: a batched-FE
+      kernel that is fast but wrong must never report a floor number.
+    """
+    from __graft_entry__ import _example_arrays
+    from lodestar_tpu.observability.compile_ledger import ledger
+    from lodestar_tpu.parallel.verifier import (
+        batch_verify_kernel,
+        individual_verify_kernel,
+        individual_verify_kernel_legacy_fe,
+    )
+
     args = [jax.device_put(a) for a in _example_arrays(WORST_CASE_BATCH)]
     jax.block_until_ready(args)
+    # verdict kernels take no r_bits (index 6): (pk, msg, sig, valid)
+    v_args = args[:6] + [args[7]]
+
+    def steady(fn, call_args):
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            r = fn(*call_args)
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / REPS
+
+    new_fn = ledger().wrap(
+        jax.jit(individual_verify_kernel), "bench_verdicts_batched_fe"
+    )
+    old_fn = ledger().wrap(
+        jax.jit(individual_verify_kernel_legacy_fe), "bench_verdicts_legacy_fe"
+    )
+    new_v = np.asarray(new_fn(*v_args))
+    old_v = np.asarray(old_fn(*v_args))
+    # the parity gate: same verdicts, and the known-valid batch passes
+    assert (new_v == old_v).all() and new_v.all(), (
+        "floor_batched_fe parity gate failed: batched-FE verdicts "
+        "diverge from per-lane FE"
+    )
+    rows = {
+        "device_sets_per_sec_verdicts_batched_fe": round(
+            WORST_CASE_BATCH / steady(new_fn, v_args), 2
+        ),
+        "device_sets_per_sec_verdicts_legacy_fe": round(
+            WORST_CASE_BATCH / steady(old_fn, v_args), 2
+        ),
+        "parity_batched_vs_legacy_fe": True,
+    }
+
     fn = ledger().wrap(jax.jit(batch_verify_kernel), "bench_batch")
     ok = bool(fn(*args))
     assert ok, "per-set bench batch failed verification"
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        r = fn(*args)
-    r.block_until_ready()
-    dt = (time.perf_counter() - t0) / REPS
     rows["device_sets_per_sec_floor_distinct_pk_and_msg"] = round(
-        WORST_CASE_BATCH / dt, 2
+        WORST_CASE_BATCH / steady(fn, args), 2
     )
     return rows
 
@@ -609,6 +660,10 @@ def main() -> None:
     _log("bench: worst-case phase...")
     with em.phase("worst_case", deadline_s=deadline) as ph:
         ph.update(_bench_worst_case(jax))
+
+    _log("bench: floor batched-FE phase...")
+    with em.phase("floor_batched_fe", deadline_s=deadline) as ph:
+        ph.update(_bench_floor_batched_fe(jax))
 
     _log("bench: adversarial-mix phase...")
     with em.phase("adversarial_mix_50pct", deadline_s=deadline) as ph:

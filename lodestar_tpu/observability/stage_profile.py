@@ -50,9 +50,13 @@ def profile_stages(
     histogram. `batch` must be a multiple of 4 (MSM subset-4 tables)."""
     import jax
 
-    from ..ops import fp, fp2, fp12, msm
+    from ..ops import fp, fp2, fp12, msm, pallas_tower
     from ..ops.g2_decompress import decompress
-    from ..ops.pairing import final_exponentiation, miller_loop_proj_pq
+    from ..ops.pairing import (
+        final_exponentiation,
+        final_exponentiation_batch,
+        miller_loop_proj_pq,
+    )
     from ..ops.points import g1, g2
 
     if batch % 4 != 0:
@@ -102,10 +106,30 @@ def profile_stages(
         rpk[0], rpk[1], msg_x, msg_y,
     )
 
+    if pallas_tower.enabled():
+        # device tag `bls/miller_pallas`: the VMEM-resident tower kernel
+        # on the affine shape it serves (interpret mode off-TPU is far
+        # slower than XLA, so this stage only runs when the knob is on)
+        _, results["miller_pallas"] = timed(
+            "miller_pallas",
+            lambda px, py, qx, qy: pallas_tower.miller_loop_pallas(
+                (px, py), (qx, qy)
+            ),
+            rpk[0], rpk[1], msg_x, msg_y,
+        )
+
     prod, results["product_tree"] = timed("product_tree", fp12.product_tree, fs)
 
     _, results["final_exp"] = timed(
         "final_exp", lambda f: fp12.is_one(final_exponentiation(f[None]))[0], prod
+    )
+
+    # device tag `bls/final_exp_batch`: the N-wide shared-inversion final
+    # exp of the per-set verdict path (ONE easy-part inversion chain for
+    # the whole batch — the latency-floor win of ISSUE 14)
+    _, results["final_exp_batch"] = timed(
+        "final_exp_batch",
+        lambda f: fp12.is_one(final_exponentiation_batch(f)), fs,
     )
 
     return {k: round(v, 6) for k, v in results.items()}

@@ -16,7 +16,10 @@ device stages (attributable two ways: `trace.named_scope` tags inside the
 fused kernel for XLA profiles, and `stage_profile.profile_stages` timing
 per-stage sub-kernels into the SAME histogram for the bench breakdown):
     g2_decompress, scalar_mul, msm_planes, miller_loop, product_tree,
-    final_exp
+    final_exp, final_exp_batch (batched shared-inversion final exp, device
+    tag `bls/final_exp_batch`), miller_pallas (VMEM-resident Pallas Miller
+    tower when LODESTAR_TPU_PALLAS_MILLER resolves on, device tag
+    `bls/miller_pallas`)
 
 All families live in a `metrics.registry.MetricsRegistry` so they render
 on `/metrics` next to the rest of the node's families. `default_pipeline()`
@@ -50,8 +53,10 @@ STAGES = (
     "scalar_mul",
     "msm_planes",
     "miller_loop",
+    "miller_pallas",
     "product_tree",
     "final_exp",
+    "final_exp_batch",
 )
 
 # planner decisions (parallel/verifier.verify_signature_sets_submit):

@@ -28,6 +28,7 @@ tests/test_ops_fp.py).
 
 from __future__ import annotations
 
+import contextlib
 import math
 
 import jax.numpy as jnp
@@ -52,6 +53,31 @@ _P = jnp.asarray(P_LIMBS)
 _TWO_P = jnp.asarray(TWO_P_LIMBS)
 _R2 = jnp.asarray(R2_LIMBS)
 _ONE_MONT = jnp.asarray(ONE_MONT_LIMBS)
+
+
+# Trace-time carry-strategy override (ISSUE 14). Every carry/borrow
+# propagation in this module — carry_scan, _cond_sub_cols, reduce_stack —
+# funnels through _carry_scan_out, so swapping its implementation inside a
+# `carry_form(...)` region reroutes a whole traced subgraph (e.g. the final
+# exponentiation's ~1,000 small muls) without threading a parameter through
+# the tower. The override is consulted at TRACE time only; the default
+# (None) keeps the graph-light lax.scan form everywhere else.
+_CARRY_OUT_OVERRIDE = None
+
+
+@contextlib.contextmanager
+def carry_form(impl):
+    """Route every carry propagation traced inside the region through
+    `impl` (signature of `_carry_scan_out`: signed columns → (canonical
+    limbs, final carry)). Pass `_ks_carry_impl` for the scan-free
+    Kogge–Stone form; None restores the default."""
+    global _CARRY_OUT_OVERRIDE
+    prev = _CARRY_OUT_OVERRIDE
+    _CARRY_OUT_OVERRIDE = impl
+    try:
+        yield
+    finally:
+        _CARRY_OUT_OVERRIDE = prev
 
 
 def carry_scan(t: jnp.ndarray) -> jnp.ndarray:
@@ -80,6 +106,8 @@ def _carry_scan_out(t: jnp.ndarray):
     otherwise — callers use it as a sign probe). The single unrolled-scan
     implementation; an unused final carry is dead-code-eliminated, so
     `carry_scan` delegating here costs nothing."""
+    if _CARRY_OUT_OVERRIDE is not None:
+        return _CARRY_OUT_OVERRIDE(t)
     tt = jnp.moveaxis(t, -1, 0)
     k = tt.shape[0]
     u = 8 if k % 8 == 0 else 1

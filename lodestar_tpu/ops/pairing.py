@@ -199,7 +199,17 @@ def _line_and_add(t, q_aff, xp_neg2, yp2, zp2, b3):
 def miller_loop(p_aff, q_aff):
     """f = conj(f_{|x|,Q}(P)) for P ∈ G1 affine (xp, yp limbs), Q ∈ G2
     affine ((2,32)-limb coords). Batched over leading axes; does NOT handle
-    infinity — callers mask (see `pairing_check`)."""
+    infinity — callers mask (see `pairing_check`).
+
+    When LODESTAR_TPU_PALLAS_MILLER resolves on (auto: TPU backends) the
+    affine loop runs the VMEM-resident Pallas tower kernel
+    (`ops/pallas_tower.py`) — bit-identical, one HBM round-trip per batch
+    tile instead of one per field op. The projective variants below keep
+    the XLA path (their lanes come out of fused point sums already)."""
+    from . import pallas_tower
+
+    if pallas_tower.enabled():
+        return pallas_tower.miller_loop_pallas(p_aff, q_aff)
     return _miller_loop_impl(p_aff[0], p_aff[1], None, q_aff[0], q_aff[1], None)
 
 
@@ -337,14 +347,50 @@ def final_exponentiation_batch(fs):
     sequential-multiply chain per lane. The hard part is already pure
     vmapped scan work and shares its latency across lanes for free.
 
-    The bisection-verdict probe path (`parallel/verifier`) calls this on
-    stacked product-tree nodes — all lanes are nonzero by construction
-    (Miller outputs and identity padding). Equal to per-lane
-    `final_exponentiation` bit-for-bit (differential test in
-    tests/test_ops_pairing.py)."""
-    f = fp12.mul(fp12.conj(fs), fp12.batch_inv(fs))  # f^(p⁶−1)
-    f = fp12.mul(fp12.frobenius(f, 2), f)  # ^(p²+1): cyclotomic now
-    return _hard_part(f)
+    The shared final-exp entry for EVERY verdict path (ISSUE 14): the
+    per-set/grouped/pk-grouped/bisect kernels and their sharded twins all
+    route here (`final_exponentiation_one` for single products). Two
+    contracts beyond the per-lane form:
+
+    - zero lanes are SAFE: a zero lane would poison the whole batch
+      through the Montgomery product, so zero lanes are substituted with
+      the identity before `batch_inv` and their inverse forced back to
+      zero afterwards — exactly what the per-lane Fermat chain computes
+      for zero (0^(p−2) = 0), keeping the entry bit-identical to
+      per-lane `final_exponentiation` on EVERY input (differential tests
+      in tests/test_ops_pairing.py and tests/test_final_exp_batch.py).
+    - the hard part's ~1,000 sequential small muls can run the scan-free
+      Kogge–Stone carry (`fp.ks_carry`) via
+      LODESTAR_TPU_FINAL_EXP_KS_CARRY=1; measured on the CPU backend the
+      carry_scan default stays (compile/runtime numbers in
+      docs/architecture.md §"Final-exp batching & Pallas Miller loop"),
+      and the knob is confined to THIS kernel — the site count elsewhere
+      blows the compile budget (fp.py round-2 lesson).
+    """
+    from ..utils.env import env_bool
+
+    carry_ctx = (
+        fp.carry_form(fp._ks_carry_impl)
+        if env_bool("LODESTAR_TPU_FINAL_EXP_KS_CARRY")
+        else fp.carry_form(None)
+    )
+    with carry_ctx:
+        nz = ~jnp.all(fp.canonical(fs) == 0, axis=(-1, -2, -3, -4))
+        safe = fp12.select(nz, fs, fp12.one(fs.shape[:-4]))
+        inv = fp12.select(nz, fp12.batch_inv(safe), fp12.zero(fs.shape[:-4]))
+        f = fp12.mul(fp12.conj(fs), inv)  # f^(p⁶−1)
+        f = fp12.mul(fp12.frobenius(f, 2), f)  # ^(p²+1): cyclotomic now
+        return _hard_part(f)
+
+
+def final_exponentiation_one(f):
+    """Final exponentiation of ONE product, routed through the shared
+    batched kernel: a unit batch axis keeps deep fp12 chains batched (the
+    axon workaround in `_miller_loop_impl`) and keeps every verdict path
+    on a single consensus-critical final-exp implementation. For n = 1
+    `fp12.batch_inv` degenerates to `fp12.inv`, so this is bit-identical
+    to per-lane `final_exponentiation`."""
+    return final_exponentiation_batch(f[None])[0]
 
 
 def pairing(p_aff, q_aff):
@@ -363,4 +409,4 @@ def pairing_check(p_affs, q_affs, valid_mask):
         return jnp.asarray(True)  # empty product == 1 (vacuous truth)
     fs = miller_loop(p_affs, q_affs)
     fs = fp12.select(valid_mask, fs, fp12.one(fs.shape[:-4]))
-    return fp12.is_one(final_exponentiation(fp12.product_tree(fs)))
+    return fp12.is_one(final_exponentiation_one(fp12.product_tree(fs)))

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability.trace import named_scope
 from ..ops import fp, fp2, fp12, msm
 
 
@@ -44,7 +45,7 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
     )
 from ..ops.pairing import (
-    final_exponentiation,
+    final_exponentiation_one,
     miller_loop_proj_pq,
     miller_loop_projective,
 )
@@ -132,7 +133,8 @@ def _sharded_verify(mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, v
         )
         f_tail = fp12.select(~s_inf, f_tail, fp12.one(()))
         f = fp12.mul(_fp12_product_tree(f_all), f_tail)
-        return fp12.is_one(final_exponentiation(f))
+        with named_scope("bls/final_exp_batch"):
+            return fp12.is_one(final_exponentiation_one(f))
 
     return _tail_on_root(mesh_axis, tail)
 
@@ -241,7 +243,8 @@ def _sharded_grouped_verify(mesh_axis, *args):
     f_all = lax.all_gather(f_loc, mesh_axis)  # (ndev, 2,3,2,32)
 
     def tail():
-        return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+        with named_scope("bls/final_exp_batch"):
+            return fp12.is_one(final_exponentiation_one(_fp12_product_tree(f_all)))
 
     return _tail_on_root(mesh_axis, tail)
 
@@ -402,7 +405,8 @@ def _sharded_pk_grouped_verify(mesh_axis, *args):
     f_all = lax.all_gather(f_loc, mesh_axis)
 
     def tail():
-        return fp12.is_one(final_exponentiation(_fp12_product_tree(f_all)))
+        with named_scope("bls/final_exp_batch"):
+            return fp12.is_one(final_exponentiation_one(_fp12_product_tree(f_all)))
 
     return _tail_on_root(mesh_axis, tail)
 
@@ -499,9 +503,10 @@ def _sharded_bisect_verify(mesh_axis, *args):
         while g_lvl.shape[0] > 1:
             g_lvl = fp12.mul(g_lvl[0::2], g_lvl[1::2])
             levels.append(g_lvl)
-        root_ok = fp12.is_one(
-            final_exponentiation(levels[-1][0])
-        ).astype(jnp.int32)
+        with named_scope("bls/final_exp_batch"):
+            root_ok = fp12.is_one(
+                final_exponentiation_one(levels[-1][0])
+            ).astype(jnp.int32)
         return root_ok, tuple(levels)
 
     def idle(_):

@@ -70,6 +70,17 @@ _register("LODESTAR_TPU_PADCONV_FP", "bool", False,
 _register("LODESTAR_TPU_PALLAS_MIN_LANES", "int", None,
           "Minimum batch lanes before the Pallas MXU kernel beats the "
           "default path; smaller batches use the fallback multiplier.")
+_register("LODESTAR_TPU_PALLAS_MILLER", "str", "auto",
+          "VMEM-resident Pallas Miller-loop tower kernel "
+          "(ops/pallas_tower.py): auto (on when the backend lowers "
+          "Pallas, i.e. TPU), 1/on (forced; interpreter mode off-TPU), "
+          "0/off.")
+_register("LODESTAR_TPU_FINAL_EXP_KS_CARRY", "bool", False,
+          "Route the final-exp hard part's carries through the scan-free "
+          "Kogge-Stone form (fp.ks_carry) inside the batched final-exp "
+          "kernel only; default stays carry_scan — measured 3.5x compile "
+          "and ~7.5x runtime WORSE on CPU (docs/architecture.md); the "
+          "knob stays for TPU re-measurement.")
 _register("LODESTAR_TPU_LAZY_FP2", "bool", True,
           "Lazy-reduction Fp2 multiplication (3 reductions -> 2); off "
           "restores the 3-full-multiply form.")

@@ -1,0 +1,36 @@
+"""Planted trace-safety violations inside Pallas kernel bodies (fixture —
+never imported; linted as text by tests/test_lint.py). Pallas kernels are
+the nastiest place for host syncs: under ``interpret=True`` they "work",
+then explode when Mosaic lowers them on the TPU path. Each numbered site
+must produce a finding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def bad_branch_kernel(x_ref, o_ref):
+    v = x_ref[...]
+    if jnp.any(v > 0):  # 1: Python branch on a traced value
+        v = -v
+    o_ref[...] = jnp.asarray(np.asarray(v))  # 2: device->host pull
+
+
+def _helper(v):
+    return v.item()  # 3: transitive — called from the kernel below
+
+
+def bad_transitive_kernel(x_ref, o_ref):
+    o_ref[...] = _helper(x_ref[...])
+
+
+def launch(x):
+    y = pl.pallas_call(
+        bad_branch_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+    return pl.pallas_call(
+        bad_transitive_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(y)

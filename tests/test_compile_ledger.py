@@ -195,6 +195,10 @@ def test_batch_verifier_kernels_are_ledger_wrapped():
         ("_pk_grouped", "pk_grouped"),
         ("_bisect_tree", "bisect_tree"),
         ("_bisect_probe", "bisect_probe"),
+        # ISSUE 14: the standalone batched final exp and the Pallas
+        # Miller tower ride the same seam
+        ("_final_exp_batch", "final_exp_batch"),
+        ("_miller_pallas", "miller_pallas"),
     ):
         assert getattr(bv, attr).__compile_ledger_kernel__ == kernel
 

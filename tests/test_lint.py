@@ -72,6 +72,18 @@ def test_trace_safety_fixture_fires():
         assert marker in messages
 
 
+def test_trace_safety_recognizes_pallas_kernels():
+    # pl.pallas_call(kernel, …) bodies run under a trace: host syncs
+    # survive interpret mode and only explode when Mosaic lowers them,
+    # so the checker must treat them as kernels statically (ISSUE 14)
+    findings = lint_fixture("pallas_bad.py")
+    assert rules_of(findings) == ["trace-safety"] * 3
+    assert len({f.line for f in findings}) == 3  # one per planted site
+    messages = "\n".join(f.message for f in findings)
+    for marker in ("Python `if`", "np.asarray", ".item()"):
+        assert marker in messages
+
+
 def test_lock_discipline_fixture_fires():
     findings = lint_fixture("locks_bad.py")
     assert rules_of(findings) == ["lock-discipline"] * 5

@@ -60,6 +60,9 @@ DEFAULT_THRESHOLD = 3.0
 # after any `phase.` prefix): the unconditional per-set floor and the
 # default-configuration wire-to-verdict rate
 REQUIRED_GATED_KEYS = (
+    # emitted by the parity-gated `floor_batched_fe` phase since ISSUE 14
+    # (previously `worst_case`); base-name matching carries the trend
+    # across the phase rename, same kernel + shape on both sides
     "device_sets_per_sec_floor_distinct_pk_and_msg",
     "e2e_wire_to_verdict_sets_per_sec",
     # the mesh-native serving rate (round-7 tentpole): the grouped kernel

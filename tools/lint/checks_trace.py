@@ -36,6 +36,10 @@ _WRAPPER_LEAVES = (
     "jit", "pjit", "shard_map", "_shard_map", "vmap", "pmap",
     "scan", "fori_loop", "while_loop", "cond", "switch", "checkpoint",
     "remat", "custom_jvp", "custom_vjp", "grad", "value_and_grad",
+    # Pallas kernel bodies (pl.pallas_call(kernel, …)) run under a trace
+    # too — and worse, host syncs "work" in interpret mode and only
+    # explode when Mosaic lowers them, so they must be caught statically.
+    "pallas_call",
 )
 _NP_ROOTS = ("np", "numpy", "onp")
 _HOST_PULL_ATTRS = ("item", "tolist", "block_until_ready")

@@ -21,7 +21,9 @@ steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
 (16x8, 64x64) + the pk-grouped config (128x32 — the adversarial
 unique-root flood defense routes here) + the bisection-verdict tree
 kernel per bucket and its fixed-shape probe kernel (the per-set verdict
-path, round 6) + the bench shapes when --bench is given. Device
+path, round 6) + the standalone batched final exp and — when
+LODESTAR_TPU_PALLAS_MILLER resolves on — the Pallas Miller tower
+(ISSUE 14) + the bench shapes when --bench is given. Device
 decompression is DEFAULT-ON (round 6), so the *_raw kernel variants —
 on-chip signature decode + subgroup checks — are warmed for the same
 shapes by default; LODESTAR_TPU_DEVICE_DECOMPRESS=0 (or
@@ -130,6 +132,35 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
     jax.block_until_ready(probe)
     print(f"bisect probe x{PROBE_LANES}: {time.monotonic() - t0:.1f}s",
           flush=True)
+    # the standalone shared-inversion batched final exp (ISSUE 14): the
+    # bench floor comparison and /debug/compiles entry for the batched-FE
+    # tail every verdict kernel inlines
+    t0 = time.monotonic()
+    fe = bv.final_exp_batch(np.asarray(_fp12.one((PROBE_LANES,))))
+    jax.block_until_ready(fe)
+    print(f"final exp batch x{PROBE_LANES}: {time.monotonic() - t0:.1f}s",
+          flush=True)
+    timeline().mark("rung_final_exp_batch")
+    # the VMEM-resident Pallas Miller tower: warmed only when the
+    # LODESTAR_TPU_PALLAS_MILLER knob resolves on (TPU deploys; the CPU
+    # interpreter path is a differential-test vehicle, not a serving
+    # shape worth a warmup rung)
+    from lodestar_tpu.ops import pallas_tower
+
+    if pallas_tower.enabled():
+        arrs = SetArrays(buckets[0])
+        (arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+         arrs.sig_x, arrs.sig_y, _r_bits, arrs.valid) = _example_arrays(
+            buckets[0]
+        )
+        t0 = time.monotonic()
+        out = bv.miller_pallas(
+            (arrs.pk_x, arrs.pk_y), (arrs.msg_x, arrs.msg_y)
+        )
+        jax.block_until_ready(out)
+        print(f"miller pallas x{buckets[0]}: {time.monotonic() - t0:.1f}s",
+              flush=True)
+        timeline().mark("rung_miller_pallas")
     for rows, lanes in grouped:
         if device_decompress:
             g, a_bits, b_bits, sig_raw = _example_grouped(rows, lanes, raw=True)
