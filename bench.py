@@ -502,6 +502,182 @@ def _bench_sharded_grouped(jax, pipeline) -> dict | None:
     }
 
 
+def _bench_e2e_mesh_raw(jax, pipeline, headline_rate) -> dict | None:
+    """Wire-bytes → verdict through the MESH raw path (ISSUE 15 tentpole):
+    the no-flags default facade with a mesh attached — host marshal is a
+    pure byte scatter (signatures stay compressed wire bytes), each chip
+    decompresses its own row slice on device via the sharded `*_raw`
+    twins, then the usual grouped pairing check.
+
+    Parity gate before the timed reps, same contract as
+    `_bench_sharded_grouped`: on ONE marshalled batch with ONE set of
+    random coefficients, the sharded-raw verdict must equal the
+    single-device raw kernel's — once valid, once with a tampered
+    signature byte. Then the timed region is the production facade
+    (`verify_signature_sets_submit`, pipelined), so the row is honestly
+    wire→verdict: plan + scatter + mesh dispatch every rep.
+
+    `e2e_mesh_raw_vs_device_headline` is the acceptance ratio: the mesh
+    path must hold ≥0.7× the single-device headline on this host."""
+    from lodestar_tpu import native
+    from lodestar_tpu.bls import api as bls
+    from lodestar_tpu.parallel.mesh import NOT_SHARDED, BlsMeshDispatcher
+    from lodestar_tpu.parallel.sharded import mesh_divisor
+    from lodestar_tpu.parallel.verifier import TpuBlsVerifier, _rand_pairs
+
+    if not native.HAVE_NATIVE_BLS:
+        return None
+    devices = jax.devices()
+    n = mesh_divisor(len(devices))
+    if n < 2:
+        return None  # single chip — no mesh ingest path to measure
+
+    rows_, lanes = UNIQUE_ROOTS, 64  # the 64x64 warmup-rung shape; 64 % n == 0
+    batch = rows_ * lanes
+    n_keys = 64
+    sks = [bls.interop_secret_key(i) for i in range(n_keys)]
+    pks = [sk.to_public_key() for sk in sks]
+    roots = [bytes([r]) * 32 for r in range(rows_)]
+    sig_cache: dict[tuple[int, int], bytes] = {}
+    sets = []
+    for i in range(batch):
+        k, m = i % n_keys, (i * 7) % rows_
+        sig = sig_cache.get((k, m))
+        if sig is None:
+            sig = sig_cache[(k, m)] = sks[k].sign(roots[m]).to_bytes()
+        sets.append(
+            bls.SignatureSet(pubkey=pks[k], message=roots[m], signature=sig)
+        )
+
+    dispatcher = BlsMeshDispatcher(devices[:n], observer=pipeline)
+    verifier = TpuBlsVerifier(
+        buckets=(batch,), grouped_configs=((rows_, lanes),), mesh=dispatcher
+    )
+    if not verifier._device_decompress:
+        return None  # DEVICE_DECOMPRESS=0 host: no raw ingest to bench
+
+    plan = verifier._plan_groups(sets)
+    assert plan is not None, "e2e mesh batch must group (64 shared roots)"
+    marshalled = verifier._marshal_grouped(sets, plan, raw=True)
+    assert marshalled is not None, "native tier refused the raw marshal"
+    g, sig_raw = marshalled
+    a_bits, b_bits = _rand_pairs(g.valid.shape)
+    r = dispatcher.dispatch_grouped_raw(g, sig_raw, a_bits, b_bits)
+    assert r is not NOT_SHARDED, "mesh dispatcher refused the e2e raw batch"
+    ok = bool(r)
+    assert ok == bool(
+        verifier.kernels.verify_grouped_raw(g, sig_raw, a_bits, b_bits)
+    ) and ok, "sharded-raw verdict diverged on valid batch"
+    sig_raw[0, 0, 10] ^= 1  # tampered wire byte: identical rejection
+    assert bool(
+        dispatcher.dispatch_grouped_raw(g, sig_raw, a_bits, b_bits)
+    ) == bool(
+        verifier.kernels.verify_grouped_raw(g, sig_raw, a_bits, b_bits)
+    ) == False, "sharded-raw verdict diverged on tampered batch"
+    sig_raw[0, 0, 10] ^= 1
+
+    ok = verifier.verify_signature_sets(sets)  # compile + correctness gate
+    assert ok, "e2e mesh batch failed verification"
+    verifier._h2c_cache.clear()  # first timed rep pays the unique hashes
+    verifier._pk_cache.clear()
+    t0 = time.perf_counter()
+    pending = None
+    for _ in range(REPS):
+        nxt = verifier.verify_signature_sets_submit(sets)
+        if pending is not None:
+            assert pending()
+        pending = nxt
+    assert pending()
+    dt = (time.perf_counter() - t0) / REPS
+    rate = batch / dt
+    out = {
+        "e2e_mesh_raw_sets_per_sec": round(rate, 2),
+        "e2e_mesh_raw_devices": n,
+        "e2e_mesh_raw_verdicts_match_unsharded": 1,
+    }
+    if headline_rate:
+        out["e2e_mesh_raw_vs_device_headline"] = round(rate / headline_rate, 4)
+    return out
+
+
+def _bench_flood(pipeline) -> dict:
+    """Gossip-flood drill through the lane dispatcher (ISSUE 15): 16
+    attester threads hammer 1-set requests with tiny lane caps while a
+    proposer thread submits a 2-set block every 25 ms. The dispatcher is
+    backed by a FIXED-SERVICE-TIME mock (no crypto) so the numbers
+    isolate the SCHEDULING policy: the block lane must hold its latency
+    (p50/p99 rows) and shed NOTHING while attestations shed freely."""
+    import threading
+
+    from lodestar_tpu.chain.bls_verifier import BlsShedError, MockBlsVerifier
+    from lodestar_tpu.chain.dispatcher import BlsLaneDispatcher
+
+    service_s = 0.004
+
+    class _FixedService(MockBlsVerifier):
+        def verify_signature_sets(self, sets) -> bool:
+            time.sleep(service_s)
+            return super().verify_signature_sets(sets)
+
+    d = BlsLaneDispatcher(
+        _FixedService(), max_sigs=64, max_wait_ms=4, pipeline=pipeline,
+        workers=2, max_coalesce=256, pending_cap=8,
+        lane_caps={"attestation": 4, "aggregate": 4, "sync_committee": 4},
+        waiter_timeout_s=30.0,
+    )
+    stop_at = time.perf_counter() + 2.0
+    counts = {"att_ok": 0, "att_shed": 0}
+    lock = threading.Lock()
+    block_lat: list[float] = []
+
+    def attester():
+        while time.perf_counter() < stop_at:
+            try:
+                d.verify_signature_sets(["att"], lane="attestation")
+                with lock:
+                    counts["att_ok"] += 1
+            except BlsShedError:
+                with lock:
+                    counts["att_shed"] += 1
+
+    def proposer():
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            ok = d.verify_signature_sets(["blk", "blk"], lane="block")
+            block_lat.append(time.perf_counter() - t0)
+            assert ok, "block verify failed under flood"
+            time.sleep(0.025)
+
+    threads = [threading.Thread(target=attester, daemon=True) for _ in range(16)]
+    threads.append(threading.Thread(target=proposer, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    d.close()
+
+    snap = pipeline.lanes_snapshot()
+    lat = np.asarray(block_lat)
+    rows = {
+        "flood_block_verify_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "flood_block_verify_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "flood_block_requests": len(block_lat),
+        "flood_block_sheds": snap["sheds"].get("block", 0),
+        "flood_attestation_verified": counts["att_ok"],
+        "flood_attestation_sheds": counts["att_shed"],
+        "flood_overlap_fraction": snap["overlap_fraction"],
+        "flood_service_time_ms": service_s * 1e3,
+    }
+    # the acceptance shape: blocks NEVER shed, attestations DID (the
+    # caps are sized so an un-prioritized dispatcher could not pass)
+    assert rows["flood_block_sheds"] == 0, "a block was shed under flood"
+    assert counts["att_shed"] > 0, "flood never saturated the lane caps"
+    assert rows["flood_block_verify_p99_ms"] < 500.0, (
+        "block lane failed to hold latency under flood"
+    )
+    return rows
+
+
 def _bench_hasher() -> dict:
     """Incremental state hashing at mainnet registry scale (CPU tier)."""
     from lodestar_tpu.ssz.hashing import mix_in_length
@@ -573,6 +749,9 @@ def main() -> None:
     # mesh serving counters (round 7): mesh size / evictions / per-chip
     # dispatch counts — the sharded_grouped phase drives these
     em.add_section("mesh", pipeline.mesh_snapshot)
+    # lane dispatcher state (ISSUE 15): queue depths / sheds / coalescing
+    # — the flood phase drives these; None until a dispatcher binds
+    em.add_section("lanes", pipeline.lanes_snapshot)
     # compile accounting + cold-start timeline: which kernels compiled
     # this run, cache hit/miss, cumulative compile seconds, and the
     # process-start→serving-ready phase marks
@@ -696,6 +875,21 @@ def main() -> None:
                 f"{sharded_rows['sharded_grouped_sets_per_sec']:.1f} sets/s "
                 f"on {sharded_rows['mesh_devices']} device(s)"
             )
+
+    _log("bench: e2e mesh-raw phase...")
+    with em.phase("e2e_mesh_raw", deadline_s=deadline) as ph:
+        mesh_e2e_rows = _bench_e2e_mesh_raw(jax, pipeline, grouped_rate)
+        if mesh_e2e_rows is not None:
+            ph.update(mesh_e2e_rows)
+            _log(
+                "bench: e2e mesh-raw "
+                f"{mesh_e2e_rows['e2e_mesh_raw_sets_per_sec']:.1f} sets/s "
+                f"on {mesh_e2e_rows['e2e_mesh_raw_devices']} device(s)"
+            )
+
+    _log("bench: flood phase...")
+    with em.phase("flood", deadline_s=deadline) as ph:
+        ph.update(_bench_flood(pipeline))
 
     _log("bench: stage-profile phase...")
     with em.phase("stage_profile", deadline_s=deadline) as ph:

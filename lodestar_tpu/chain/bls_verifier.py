@@ -28,6 +28,26 @@ MAX_BUFFERED_SIGS = 32
 MAX_BUFFER_WAIT_MS = 100
 
 
+class BlsShedError(RuntimeError):
+    """Typed rejection for a verify request shed by dispatcher admission
+    control (per-lane queue caps / flood load-shedding in
+    `chain/dispatcher.BlsLaneDispatcher`).
+
+    Waiters of a shed request get this PROMPTLY — the shed decision
+    resolves their event immediately — never the 300 s
+    LODESTAR_TPU_WAITER_TIMEOUT escalation ride (that path is for a
+    WEDGED flush thread, not a deliberate policy decision). Callers map
+    it to the gossip IGNORE action: shedding our own overload must not
+    penalize peers."""
+
+    def __init__(self, lane: str, n_sets: int, why: str = "shed"):
+        super().__init__(
+            f"bls verify request shed ({why}): lane={lane} sets={n_sets}"
+        )
+        self.lane = lane
+        self.n_sets = n_sets
+
+
 class IBlsVerifier(Protocol):
     def verify_signature_sets(self, sets: Sequence[bls.SignatureSet]) -> bool: ...
 
@@ -424,8 +444,17 @@ class ThreadBufferedVerifier:
                 "lodestar_bls_verifier_waiter_timeouts_total",
                 self.waiter_timeout, len(sets),
             )
-            return holder[0] if holder[0] is not None else False
-        return holder[0]
+            out = holder[0]
+            if isinstance(out, BlsShedError):
+                raise out
+            return out if out is not None else False
+        out = holder[0]
+        if isinstance(out, BlsShedError):
+            # a shed entry resolves its waiter IMMEDIATELY with the typed
+            # rejection — re-raise it here so callers can map overload to
+            # the gossip IGNORE action instead of reading a False verdict
+            raise out
+        return out
 
     def _take_locked(self):
         entries, self._entries = self._entries, []

@@ -1,0 +1,396 @@
+"""Continuous-batching BLS dispatcher with priority lanes.
+
+`ThreadBufferedVerifier` (chain/bls_verifier.py) is stop-and-wait: the
+host sits idle while the device computes, then the device sits idle
+while the host preps the next batch, and every gossip topic shares one
+undifferentiated buffer — a flood of attestations can starve a block
+proposal of its verification slot. This module applies the LLM-serving
+continuous-batching idea (Orca-style iteration scheduling, vLLM-style
+admission control) to BLS dispatch:
+
+- **Coalescing** — requests arriving while the device is busy merge into
+  the NEXT batch instead of waiting a full round-trip each.
+- **Double-buffering** — two (configurable) worker threads call the
+  wrapped verifier concurrently, so host marshal of batch N+1 overlaps
+  device compute of batch N; the supervisor's dispatch lock serializes
+  the actual device step, making the overlap pure host/device pipelining.
+- **Priority lanes** — block > sync_committee > aggregate > attestation,
+  mirroring the reference beacon node's gossip queue shapes. A batch is
+  drained in strict lane order, so a block's signature sets always ride
+  the first batch out.
+- **Admission control / load-shedding** — per-lane queue caps (block is
+  NEVER capped or shed) plus a global pending cap; under flood, queued
+  attestations are evicted first, then aggregates, then sync-committee
+  messages. Shed waiters get a PROMPT typed `BlsShedError` (mapped to
+  gossip IGNORE by callers), never the waiter-timeout escalation ride.
+  When the PR-4 supervisor breaker is open (device evicted, CPU tier
+  serving), effective lane caps halve — the slow tier gets a shorter
+  queue rather than a longer one.
+
+Lint note (tools/lint/checks_locks.py): all `# guarded-by: _lock` state
+is mutated only inside `*_locked` helpers; the Condition wraps the same
+`self._lock` the annotations name.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from .bls_verifier import (
+    BlsShedError,
+    ThreadBufferedVerifier,
+    _verify_merged,
+)
+
+__all__ = ["BlsLaneDispatcher", "BlsShedError", "LANES", "DEFAULT_LANE"]
+
+# Strict priority order, highest first (reference gossip queue shapes).
+LANES = ("block", "sync_committee", "aggregate", "attestation")
+LANE_PRIORITY = {lane: i for i, lane in enumerate(LANES)}
+DEFAULT_LANE = "aggregate"
+
+
+def _lane_caps_from_env() -> dict[str, int]:
+    from ..utils.env import env_int
+
+    # block is deliberately absent: the block lane is never capped.
+    return {
+        "sync_committee": env_int("LODESTAR_TPU_LANE_CAP_SYNC_COMMITTEE"),
+        "aggregate": env_int("LODESTAR_TPU_LANE_CAP_AGGREGATE"),
+        "attestation": env_int("LODESTAR_TPU_LANE_CAP_ATTESTATION"),
+    }
+
+
+class BlsLaneDispatcher(ThreadBufferedVerifier):
+    """Drop-in `ThreadBufferedVerifier` replacement with continuous
+    batching, four priority lanes, and flood load-shedding.
+
+    `verify_signature_sets(sets, batchable=True, lane="aggregate")`
+    blocks the calling (gossip-executor) thread until its verdict is
+    ready, exactly like the base facade — but raises `BlsShedError`
+    promptly when admission control sheds the request. Unknown lanes
+    route to the default lane, so existing callers keep working
+    unchanged."""
+
+    def __init__(self, verifier, max_sigs: int | None = None,
+                 max_wait_ms: float | None = None, prom=None, pipeline=None,
+                 waiter_timeout_s: float | None = None,
+                 workers: int | None = None, max_coalesce: int | None = None,
+                 pending_cap: int | None = None,
+                 lane_caps: dict[str, int] | None = None):
+        from .bls_verifier import MAX_BUFFER_WAIT_MS, MAX_BUFFERED_SIGS
+        from ..utils.env import env_int
+
+        super().__init__(
+            verifier,
+            max_sigs=MAX_BUFFERED_SIGS if max_sigs is None else max_sigs,
+            max_wait_ms=MAX_BUFFER_WAIT_MS if max_wait_ms is None else max_wait_ms,
+            prom=prom, pipeline=pipeline, waiter_timeout_s=waiter_timeout_s,
+        )
+        self.workers = env_int("LODESTAR_TPU_LANE_WORKERS") if workers is None else workers
+        self.max_coalesce = (
+            env_int("LODESTAR_TPU_LANE_MAX_COALESCE")
+            if max_coalesce is None else max_coalesce
+        )
+        self.pending_cap = (
+            env_int("LODESTAR_TPU_LANE_PENDING_CAP")
+            if pending_cap is None else pending_cap
+        )
+        self.lane_caps = _lane_caps_from_env() if lane_caps is None else dict(lane_caps)
+        # the Condition shares self._lock (created by the base __init__),
+        # so waiters/notifies and the guarded-by annotations agree
+        self._cv = threading.Condition(self._lock)
+        # entry: (sets, event, holder, lane, t_enqueued)
+        self._lane_q: dict[str, deque] = {lane: deque() for lane in LANES}  # guarded-by: _lock
+        self._lane_sets: dict[str, int] = {lane: 0 for lane in LANES}  # guarded-by: _lock
+        self._pending_sets = 0  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self.pipeline.bind_lane_depths(self._lanes_state)
+        self._threads = [
+            threading.Thread(
+                target=self._worker_loop, name=f"bls-lane-worker-{i}", daemon=True
+            )
+            for i in range(max(1, self.workers))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- observability ------------------------------------------------------
+
+    def _buffered_sigs(self) -> int:
+        with self._lock:
+            return self._pending_sets
+
+    def _lanes_state(self) -> dict:
+        """Live state for `/debug/lanes` and `pipeline.lanes_snapshot()`."""
+        with self._lock:
+            return {
+                "lanes": {
+                    lane: {
+                        "queued_sets": self._lane_sets[lane],
+                        "queued_requests": len(self._lane_q[lane]),
+                        "cap": self.lane_caps.get(lane, 0),
+                    }
+                    for lane in LANES
+                },
+                "pending_sets": self._pending_sets,
+                "pending_cap": self.pending_cap,
+                "inflight_batches": self._inflight,
+                "workers": len(self._threads),
+                "max_coalesce": self.max_coalesce,
+                "closed": self._closed,
+            }
+
+    # -- admission ----------------------------------------------------------
+
+    def _breaker_open(self) -> bool:
+        """True when the wrapped (supervised) verifier's breaker is open —
+        device evicted, CPU tier serving — so effective lane caps halve:
+        a ~300x slower tier needs a shorter queue, not a longer one."""
+        try:
+            return getattr(self.verifier, "breaker_state", None) == "open"
+        except Exception:
+            return False  # unsupervised verifier: no breaker, no halving
+
+    def verify_signature_sets(self, sets, batchable: bool = True,
+                              lane: str = DEFAULT_LANE) -> bool:
+        sets = list(sets)
+        if not sets:
+            return False
+        if lane not in LANE_PRIORITY:
+            lane = DEFAULT_LANE
+        # latency-critical callers and calls already at batch size skip
+        # the queue entirely (base-facade contract, batchable=False)
+        if not batchable or len(sets) >= self.max_sigs:
+            if self.prom is not None:
+                self.prom.bls_main_thread_sets_total.inc(len(sets))
+            return self.verifier.verify_signature_sets(sets)
+        ev = threading.Event()
+        holder: list = [None]
+        with self._cv:
+            if self._closed:
+                shed, direct = None, True
+            else:
+                shed = self._admit_locked(sets, ev, holder, lane)
+                direct = False
+        if direct:
+            return self.verifier.verify_signature_sets(sets)
+        if shed is not None:
+            raise shed
+        if not ev.wait(self.waiter_timeout):
+            self.pipeline.waiter_timeout()
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").error(
+                "verify waiter gave up after %.1fs: lane workers wedged "
+                "(%d sets, lane=%s); counted in "
+                "lodestar_bls_verifier_waiter_timeouts_total",
+                self.waiter_timeout, len(sets), lane,
+            )
+            out = holder[0]
+            if isinstance(out, BlsShedError):
+                raise out
+            return out if out is not None else False
+        out = holder[0]
+        if isinstance(out, BlsShedError):
+            raise out
+        return out
+
+    def _admit_locked(self, sets, ev, holder, lane):
+        """Admission control under the lock. Returns a `BlsShedError` to
+        raise (request NOT queued) or None (queued, worker notified)."""
+        n = len(sets)
+        cap = self.lane_caps.get(lane, 0)
+        if cap and self._breaker_open():
+            cap = max(1, cap // 2)
+        if cap and lane != "block" and self._lane_sets[lane] + n > cap:
+            self.pipeline.lane_shed(lane, n)
+            return BlsShedError(lane, n, "lane cap")
+        if self.pending_cap and self._pending_sets + n > self.pending_cap:
+            # flood: evict strictly-lower-priority queued work first …
+            self._evict_locked(
+                self._pending_sets + n - self.pending_cap, LANE_PRIORITY[lane]
+            )
+            # … and if that freed nothing (we ARE the lowest priority
+            # with work), shed the incoming request — unless it's a block
+            if self._pending_sets + n > self.pending_cap and lane != "block":
+                self.pipeline.lane_shed(lane, n)
+                return BlsShedError(lane, n, "pending cap")
+        self._lane_q[lane].append((sets, ev, holder, lane, time.monotonic()))
+        self._lane_sets[lane] += n
+        self._pending_sets += n
+        if self.prom is not None:
+            self.prom.bls_buffer_depth.set(self._pending_sets)
+        self.pipeline.lane_depth_set(lane, self._lane_sets[lane])
+        self._cv.notify()
+        return None
+
+    def _evict_locked(self, need: int, incoming_priority: int) -> int:
+        """Shed queued entries from the lowest-priority non-empty lane
+        upward until `need` sets are freed, never touching the block lane
+        or any lane at/above the incoming request's priority. Evicted
+        waiters resolve IMMEDIATELY with the typed rejection."""
+        freed = 0
+        for lane in reversed(LANES):  # attestation first, block last
+            if LANE_PRIORITY[lane] <= incoming_priority or lane == "block":
+                break
+            q = self._lane_q[lane]
+            evicted = 0
+            while q and freed < need:
+                e_sets, e_ev, e_holder, e_lane, _ = q.popleft()
+                k = len(e_sets)
+                self._lane_sets[lane] -= k
+                self._pending_sets -= k
+                freed += k
+                evicted += k
+                e_holder[0] = BlsShedError(
+                    e_lane, k, "evicted by higher-priority traffic"
+                )
+                e_ev.set()
+            if evicted:
+                self.pipeline.lane_shed(lane, evicted)
+                self.pipeline.lane_depth_set(lane, self._lane_sets[lane])
+            if freed >= need:
+                break
+        return freed
+
+    # -- worker loop (continuous batching) ----------------------------------
+
+    def _ready_reason_locked(self):
+        """Why the head-of-queue work should dispatch NOW, or None."""
+        if self._pending_sets == 0:
+            return None
+        if self._lane_q["block"]:
+            return "priority"  # a block never waits out the timer window
+        if self._pending_sets >= self.max_sigs:
+            return "size"
+        if self._inflight and self._pending_sets >= max(1, self.max_sigs // 2):
+            # device busy and a half-batch is waiting: prep it now so the
+            # host marshal overlaps the in-flight device step
+            return "overlap"
+        oldest = self._oldest_enqueue_locked()
+        if oldest is not None and time.monotonic() - oldest >= self.max_wait:
+            return "timer"
+        return None
+
+    def _oldest_enqueue_locked(self):
+        oldest = None
+        for q in self._lane_q.values():
+            if q and (oldest is None or q[0][4] < oldest):
+                oldest = q[0][4]
+        return oldest
+
+    def _wait_timeout_locked(self) -> float | None:
+        oldest = self._oldest_enqueue_locked()
+        if oldest is None:
+            return None  # nothing queued: sleep until notified
+        return max(0.001, self.max_wait - (time.monotonic() - oldest))
+
+    def _pop_locked(self):
+        """Drain queued entries in strict lane-priority order, coalescing
+        up to `max_coalesce` sets into one device batch (always at least
+        one entry, however large)."""
+        entries: list = []
+        n_sets = 0
+        for lane in LANES:
+            q = self._lane_q[lane]
+            while q and (not entries or n_sets + len(q[0][0]) <= self.max_coalesce):
+                e = q.popleft()
+                k = len(e[0])
+                self._lane_sets[lane] -= k
+                self._pending_sets -= k
+                n_sets += k
+                entries.append(e)
+            self.pipeline.lane_depth_set(lane, self._lane_sets[lane])
+            if entries and n_sets >= self.max_coalesce:
+                break
+        if self.prom is not None:
+            self.prom.bls_buffer_depth.set(self._pending_sets)
+        return entries, n_sets
+
+    def _begin_batch_locked(self) -> bool:
+        overlapped = self._inflight > 0
+        self._inflight += 1
+        return overlapped
+
+    def _end_batch_locked(self) -> None:
+        self._inflight -= 1
+        self._cv.notify_all()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._closed:
+                        return  # close() already shed every queued entry
+                    reason = self._ready_reason_locked()
+                    if reason is not None:
+                        break
+                    self._cv.wait(self._wait_timeout_locked())
+                entries, n_sets = self._pop_locked()
+                overlapped = self._begin_batch_locked()
+            try:
+                if entries:
+                    self._dispatch_batch(entries, n_sets, reason, overlapped)
+            finally:
+                with self._cv:
+                    self._end_batch_locked()
+
+    def _dispatch_batch(self, entries, n_sets, reason, overlapped) -> None:
+        now = time.monotonic()
+        if self.prom is not None:
+            for _, _, _, _, enq in entries:
+                self.prom.bls_buffer_wait_seconds.observe(now - enq)
+        self.pipeline.lane_coalesce(n_sets)
+        self.pipeline.lane_overlap(overlapped)
+        t0 = time.monotonic()
+        try:
+            per_request = _verify_merged(
+                self.verifier, [e[0] for e in entries], self.metrics, self.prom
+            )
+        except Exception:
+            per_request = [False] * len(entries)
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").exception(
+                "lane batch verification failed; resolving %d requests as "
+                "invalid", len(entries),
+            )
+        self.pipeline.flush(reason, latency_s=time.monotonic() - t0)
+        for (_, ev, holder, _, _), verdict in zip(entries, per_request):
+            holder[0] = verdict
+            ev.set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _close_locked(self) -> None:
+        self._closed = True
+        for lane in LANES:
+            q = self._lane_q[lane]
+            shed = 0
+            while q:
+                e_sets, e_ev, e_holder, e_lane, _ = q.popleft()
+                k = len(e_sets)
+                self._lane_sets[lane] -= k
+                self._pending_sets -= k
+                shed += k
+                e_holder[0] = BlsShedError(e_lane, k, "dispatcher closed")
+                e_ev.set()
+            if shed:
+                self.pipeline.lane_shed(lane, shed)
+            self.pipeline.lane_depth_set(lane, 0)
+        self._cv.notify_all()
+
+    def close(self) -> None:
+        """Stop the workers; queued waiters get the prompt typed shed
+        rejection (the node is shutting down, not wedged). Idempotent;
+        post-close verify calls go straight to the wrapped verifier."""
+        with self._cv:
+            if self._closed:
+                return
+            self._close_locked()
+        for t in self._threads:
+            t.join(timeout=10.0)

@@ -27,6 +27,42 @@ class GossipAction(str, Enum):
     REJECT = "REJECT"
 
 
+_LANE_SUPPORT: dict = {}
+
+
+def _verify_lane(verifier, sets, lane: str) -> bool:
+    """verify_signature_sets with the priority-lane hint where the facade
+    accepts one (`BlsLaneDispatcher`); plain verifiers get the classic
+    call. Detection mirrors `chain._verify_now`: from the signature,
+    cached per underlying function — never by catching TypeError around
+    the live call (which would swallow a genuine TypeError raised inside
+    verification and re-run the batch). A `**kwargs` catch-all counts so
+    thin forwarding wrappers still deliver the hint.
+
+    A `BlsShedError` raised here propagates to the ladder's caller: every
+    gossip ladder maps it to IGNORE (our own overload must not penalize
+    the peer) and the handler's catch-all (`gossip/handlers._process`)
+    already treats any escaped exception as IGNORE."""
+    fn = verifier.verify_signature_sets
+    key = getattr(fn, "__func__", fn)
+    supports = _LANE_SUPPORT.get(key)
+    if supports is None:
+        import inspect
+
+        try:
+            params = inspect.signature(fn).parameters
+            supports = "lane" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):
+            supports = False
+        _LANE_SUPPORT[key] = supports
+    if supports:
+        return verifier.verify_signature_sets(sets, lane=lane)
+    return verifier.verify_signature_sets(sets)
+
+
 @dataclass
 class ValidationResult:
     action: GossipAction
@@ -117,10 +153,17 @@ def validate_gossip_attestation(
             signature=bytes(attestation.signature),
         ),
     )
-    with _spans.tracer.span(
-        "validation/bls_verify", sets=1, slot=int(data.slot)
-    ):
-        sig_ok = chain.bls.verify_signature_sets([sig_set])
+    from .bls_verifier import BlsShedError
+
+    try:
+        with _spans.tracer.span(
+            "validation/bls_verify", sets=1, slot=int(data.slot)
+        ):
+            sig_ok = _verify_lane(chain.bls, [sig_set], "attestation")
+    except BlsShedError:
+        # dispatcher admission control shed us under flood: IGNORE (no
+        # peer penalty) — attestations are the first lane to shed
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
     if not sig_ok:
         return ValidationResult(GossipAction.REJECT, "invalid signature")
 
@@ -316,10 +359,17 @@ def validate_gossip_aggregate_and_proof(chain, types, signed_agg) -> ValidationR
         signature=bytes(signed_agg.signature),
     )
     att_set = attestation_signature_set(target_state, types, attestation)
-    with _spans.tracer.span(
-        "validation/bls_verify", sets=3, slot=int(data.slot)
-    ):
-        sigs_ok = chain.bls.verify_signature_sets([sel_set, env_set, att_set])
+    from .bls_verifier import BlsShedError
+
+    try:
+        with _spans.tracer.span(
+            "validation/bls_verify", sets=3, slot=int(data.slot)
+        ):
+            sigs_ok = _verify_lane(
+                chain.bls, [sel_set, env_set, att_set], "aggregate"
+            )
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
     if not sigs_ok:
         return ValidationResult(GossipAction.REJECT, "invalid signatures")
 
@@ -356,10 +406,16 @@ def validate_gossip_voluntary_exit(chain, types, signed_exit) -> ValidationResul
         return ValidationResult(GossipAction.REJECT, "exit epoch in future")
     if cur_epoch < int(v.activation_epoch) + chain.config.chain.SHARD_COMMITTEE_PERIOD:
         return ValidationResult(GossipAction.REJECT, "validator too young")
-    if not chain.bls.verify_signature_sets(
-        [voluntary_exit_signature_set(head, signed_exit)]
-    ):
-        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    from .bls_verifier import BlsShedError
+
+    try:
+        if not _verify_lane(
+            chain.bls, [voluntary_exit_signature_set(head, signed_exit)],
+            "aggregate",
+        ):
+            return ValidationResult(GossipAction.REJECT, "invalid signature")
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
     return ValidationResult(GossipAction.ACCEPT)
 
 
@@ -381,10 +437,16 @@ def validate_gossip_proposer_slashing(chain, types, slashing) -> ValidationResul
     v = head.state.validators[index]
     if bool(v.slashed):
         return ValidationResult(GossipAction.IGNORE, "already slashed")
-    if not chain.bls.verify_signature_sets(
-        proposer_slashing_signature_sets(head, slashing)
-    ):
-        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    from .bls_verifier import BlsShedError
+
+    try:
+        if not _verify_lane(
+            chain.bls, proposer_slashing_signature_sets(head, slashing),
+            "aggregate",
+        ):
+            return ValidationResult(GossipAction.REJECT, "invalid signature")
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
     return ValidationResult(GossipAction.ACCEPT)
 
 
@@ -406,10 +468,16 @@ def validate_gossip_attester_slashing(chain, types, slashing) -> ValidationResul
     }
     if not slashable:
         return ValidationResult(GossipAction.IGNORE, "no new slashable indices")
-    if not chain.bls.verify_signature_sets(
-        attester_slashing_signature_sets(head, slashing)
-    ):
-        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    from .bls_verifier import BlsShedError
+
+    try:
+        if not _verify_lane(
+            chain.bls, attester_slashing_signature_sets(head, slashing),
+            "aggregate",
+        ):
+            return ValidationResult(GossipAction.REJECT, "invalid signature")
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
     return ValidationResult(GossipAction.ACCEPT)
 
 
@@ -497,9 +565,14 @@ def validate_gossip_sync_committee(
         return ValidationResult(GossipAction.IGNORE, "already seen")
 
     # [REJECT] signature over beacon_block_root
+    from .bls_verifier import BlsShedError
+
     sig_set = sync_committee_message_signature_set(chain.head_state, msg)
-    if not chain.bls.verify_signature_sets([sig_set]):
-        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    try:
+        if not _verify_lane(chain.bls, [sig_set], "sync_committee"):
+            return ValidationResult(GossipAction.REJECT, "invalid signature")
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
 
     # re-check the seen cache after the (possibly batched/awaited)
     # signature verification, as attestation validation does
@@ -580,8 +653,13 @@ def validate_gossip_sync_contribution_and_proof(
         contribution_and_proof_signature_set(cached, signed),
         sync_contribution_signature_set(cached, contribution, participant_pubkeys),
     ]
-    if not chain.bls.verify_signature_sets(sets):
-        return ValidationResult(GossipAction.REJECT, "invalid signature")
+    from .bls_verifier import BlsShedError
+
+    try:
+        if not _verify_lane(chain.bls, sets, "sync_committee"):
+            return ValidationResult(GossipAction.REJECT, "invalid signature")
+    except BlsShedError:
+        return ValidationResult(GossipAction.IGNORE, "verifier overloaded (shed)")
 
     if chain.seen_contribution_and_proof.is_aggregator_known(
         slot, subcommittee, aggregator

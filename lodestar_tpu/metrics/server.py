@@ -12,6 +12,11 @@ profiler + lifecycle-trace control surface:
                           chips and compiled sharded verifiers
                           (parallel/mesh.py); unmeshed nodes report
                           wired: false
+    GET /debug/lanes      priority-lane dispatcher state: per-lane queue
+                          depth/caps, shed counts, coalesced batches and
+                          the double-buffer overlap fraction
+                          (chain/dispatcher.py); nodes without a lane
+                          dispatcher report wired: false
     GET /debug/faults     fault-injection plan (testing/faults.py);
                           ?set=<spec> arms it, ?clear=1 disarms — the
                           live chaos-drill control surface
@@ -50,6 +55,7 @@ class MetricsServer:
         tracer=None,
         breaker=None,
         mesh=None,
+        lanes=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -147,6 +153,22 @@ class MetricsServer:
                     if mesh is not None:
                         try:
                             snap = mesh()
+                        except Exception as e:
+                            self._send_json(500, {"error": str(e)})
+                            return
+                    if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
+                    return
+                if route == "/debug/lanes":
+                    # lanes = zero-arg callable returning the pipeline's
+                    # lanes_snapshot(); None (no lane dispatcher bound)
+                    # reports wired: false
+                    snap = None
+                    if lanes is not None:
+                        try:
+                            snap = lanes()
                         except Exception as e:
                             self._send_json(500, {"error": str(e)})
                             return

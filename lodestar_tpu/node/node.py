@@ -110,10 +110,8 @@ class BeaconNode:
             from ..utils.jax_env import enable_compile_cache
 
             enable_compile_cache()
-            from ..chain.bls_verifier import (
-                DeviceBlsVerifier,
-                ThreadBufferedVerifier,
-            )
+            from ..chain.bls_verifier import DeviceBlsVerifier
+            from ..chain.dispatcher import BlsLaneDispatcher
             from ..chain.supervisor import SupervisedBlsVerifier
 
             # pipeline telemetry rides the node registry: stage timers +
@@ -128,7 +126,12 @@ class BeaconNode:
                 CpuBlsVerifier(),
                 observer=self.metrics.pipeline,
             )
-            verifier = ThreadBufferedVerifier(
+            # continuous-batching front-end with priority lanes (block >
+            # sync-committee > aggregate > attestation): coalesces
+            # concurrent gossip verifies, double-buffers host prep
+            # against device compute, and sheds attestations first under
+            # flood (never blocks) — chain/dispatcher.py
+            verifier = BlsLaneDispatcher(
                 self.bls_supervisor, prom=self.metrics,
             )
             timeline().mark("verifier_ready")
@@ -190,6 +193,7 @@ class BeaconNode:
                     if self.bls_supervisor is not None
                     else None
                 ),
+                lanes=self.metrics.pipeline.lanes_snapshot,
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)
@@ -364,6 +368,14 @@ class BeaconNode:
         stopper = getattr(self.chain.bls, "stop_profiling", None)
         if callable(stopper):
             stopper()  # flush the XLA trace (LODESTAR_TPU_PROFILE)
+        # lane dispatcher: stop workers, shed queued waiters promptly.
+        # Looked up on the TYPE so the facade's __getattr__ delegation
+        # can't alias this onto the supervisor's close()
+        if hasattr(type(self.chain.bls), "close"):
+            try:
+                self.chain.bls.close()
+            except Exception as e:
+                self.log.error("lane dispatcher close failed: %s", e)
         if getattr(self, "bls_supervisor", None) is not None:
             self.bls_supervisor.close()  # stop canary + dispatch worker
         self.chain._verify_pool.shutdown(wait=False)

@@ -264,6 +264,32 @@ class PipelineMetrics:
             "sharded kernel dispatches per participating chip",
             label_names=("chip",),
         )
+        # priority-lane dispatcher (round 15): continuous batching with
+        # admission control — depth per lane, sheds per lane, coalesced
+        # batch size, and the double-buffer overlap fraction (how often a
+        # batch's host prep overlapped an in-flight device step)
+        self.lane_depth = r.gauge(
+            "lodestar_bls_lane_depth",
+            "signature sets queued per priority lane of the "
+            "continuous-batching dispatcher",
+            label_names=("lane",),
+        )
+        self.lane_sheds = r.counter(
+            "lodestar_bls_lane_shed_total",
+            "signature sets shed by lane admission control or "
+            "flood eviction (blocks are never shed)",
+            label_names=("lane",),
+        )
+        self.lane_coalesced_sets = r.histogram(
+            "lodestar_bls_lane_coalesced_sets",
+            "signature sets coalesced into one lane-dispatcher batch",
+            buckets=_GROUP_SIZE_BUCKETS,
+        )
+        self.lane_overlap_fraction = r.gauge(
+            "lodestar_bls_lane_overlap_fraction",
+            "fraction of dispatched batches whose host prep overlapped "
+            "device compute of an in-flight batch (double-buffering)",
+        )
         # compile-ledger / cold-start families (round 11): compilation is
         # the tax that killed both red driver rounds — these make every
         # compile event and the getting-to-serving path first-class
@@ -314,6 +340,14 @@ class PipelineMetrics:
         self._busy_lock = threading.Lock()
         self._busy_accum = 0.0
         self._busy_window_t0 = time.monotonic()
+        # lane-dispatcher state: overlap fraction is maintained from
+        # batch counters; the live per-lane depth callback is bound by
+        # the dispatcher (None until one wires up — `lanes_snapshot()`
+        # then reports unwired)
+        self._lane_lock = threading.Lock()
+        self._lane_batches = 0
+        self._lane_overlapped = 0
+        self._lane_depths_fn = None
         # the process-wide compile ledger fans its events out to every
         # live pipeline: the node registry and the bench/tools default
         # pipeline both see the same compile history (weakref — a
@@ -410,6 +444,55 @@ class PipelineMetrics:
         of one sharded dispatch."""
         for chip in chips:
             self.mesh_dispatches.inc(chip=str(chip))
+
+    # -- priority-lane dispatcher -------------------------------------------
+
+    def bind_lane_depths(self, fn) -> None:
+        """Register the dispatcher's live lane-state callback (feeds
+        `/debug/lanes` and `lanes_snapshot()`)."""
+        self._lane_depths_fn = fn
+        for lane in ("block", "sync_committee", "aggregate", "attestation"):
+            self.lane_depth.set(0, lane=lane)
+
+    def lane_depth_set(self, lane: str, n_sets: int) -> None:
+        self.lane_depth.set(n_sets, lane=lane)
+
+    def lane_shed(self, lane: str, n_sets: int) -> None:
+        self.lane_sheds.inc(n_sets, lane=lane)
+        flight_recorder.record("lane_shed", lane=lane, sets=n_sets)
+
+    def lane_coalesce(self, n_sets: int) -> None:
+        self.lane_coalesced_sets.observe(n_sets)
+
+    def lane_overlap(self, overlapped: bool) -> None:
+        with self._lane_lock:
+            self._lane_batches += 1
+            if overlapped:
+                self._lane_overlapped += 1
+            self.lane_overlap_fraction.set(
+                self._lane_overlapped / self._lane_batches
+            )
+
+    def lanes_snapshot(self) -> dict | None:
+        """Lane-dispatcher state for the bench document and `/debug/lanes`;
+        None until a dispatcher binds its depth callback."""
+        if self._lane_depths_fn is None:
+            return None
+        sheds = {
+            labels.get("lane", ""): int(v)
+            for labels, v in self.lane_sheds.collect()
+        }
+        with self._lane_lock:
+            batches = self._lane_batches
+            overlapped = self._lane_overlapped
+        snap = dict(self._lane_depths_fn())
+        snap["sheds"] = sheds
+        snap["batches"] = batches
+        snap["overlapped_batches"] = overlapped
+        snap["overlap_fraction"] = (
+            round(overlapped / batches, 4) if batches else 0.0
+        )
+        return snap
 
     # -- compile ledger / cold start ----------------------------------------
 

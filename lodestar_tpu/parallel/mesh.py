@@ -72,7 +72,9 @@ def _default_factory(kind: str, devices, axis: str):
 
     cls = {
         "grouped": sharded.ShardedGroupedVerifier,
+        "grouped_raw": sharded.ShardedGroupedRawVerifier,
         "pk_grouped": sharded.ShardedPkGroupedVerifier,
+        "pk_grouped_raw": sharded.ShardedPkGroupedRawVerifier,
         "bisect": sharded.ShardedBisectVerifier,
     }[kind]
     return cls(Mesh(np.array(devices), axis_names=(axis,)), axis)
@@ -170,6 +172,17 @@ class BlsMeshDispatcher:
         with trace.annotation(f"bls/mesh/grouped[{len(chips)}]"):
             return v.submit(g, a_bits, b_bits)
 
+    def dispatch_grouped_raw(self, g, sig_raw, a_bits, b_bits):
+        """Sharded root-grouped RAW dispatch (wire-byte signatures,
+        on-mesh decompression); NOT_SHARDED when ineligible."""
+        n = self.size
+        if n < 2 or g.pk_x.shape[0] % n:
+            return NOT_SHARDED
+        v, chips = self._verifier("grouped_raw", g.pk_x.shape[:2])
+        self._pre_dispatch("grouped_raw", chips)
+        with trace.annotation(f"bls/mesh/grouped_raw[{len(chips)}]"):
+            return v.submit(g, sig_raw, a_bits, b_bits)
+
     def dispatch_pk_grouped(self, g, a_bits, b_bits):
         """Sharded pk-grouped dispatch; NOT_SHARDED when ineligible."""
         n = self.size
@@ -179,6 +192,17 @@ class BlsMeshDispatcher:
         self._pre_dispatch("pk_grouped", chips)
         with trace.annotation(f"bls/mesh/pk_grouped[{len(chips)}]"):
             return v.submit(g, a_bits, b_bits)
+
+    def dispatch_pk_grouped_raw(self, g, sig_raw, a_bits, b_bits):
+        """Sharded pk-grouped RAW dispatch (wire-byte signatures,
+        on-mesh decompression); NOT_SHARDED when ineligible."""
+        n = self.size
+        if n < 2 or g.msg_x.shape[0] % n:
+            return NOT_SHARDED
+        v, chips = self._verifier("pk_grouped_raw", g.msg_x.shape[:2])
+        self._pre_dispatch("pk_grouped_raw", chips)
+        with trace.annotation(f"bls/mesh/pk_grouped_raw[{len(chips)}]"):
+            return v.submit(g, sig_raw, a_bits, b_bits)
 
     def dispatch_bisect(self, arrs, r_bits):
         """Sharded bisection-tree dispatch; NOT_SHARDED when ineligible

@@ -28,6 +28,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..observability.trace import named_scope
 from ..ops import fp, fp2, fp12, msm
+from ..ops.g2_decompress import (
+    decompress as _g2_decompress,
+    planes_in_subgroup as _planes_in_subgroup,
+)
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs):
@@ -66,8 +70,12 @@ __all__ = [
     "ShardedBlsVerifier",
     "make_sharded_grouped_verifier",
     "ShardedGroupedVerifier",
+    "make_sharded_grouped_raw_verifier",
+    "ShardedGroupedRawVerifier",
     "make_sharded_pk_grouped_verifier",
     "ShardedPkGroupedVerifier",
+    "make_sharded_pk_grouped_raw_verifier",
+    "ShardedPkGroupedRawVerifier",
     "make_sharded_bisect_verifier",
     "ShardedBisectVerifier",
 ]
@@ -172,7 +180,12 @@ def _grouped_local(
     64 partial G2 plane sums, one `all_gather` (64 projective points per
     chip — the only cross-chip traffic besides the final Fp12 partials)
     combines them, and the 64 constant −[2^b]g1 Miller lanes are split
-    64/n per chip so the pairing work shards too."""
+    64/n per chip so the pairing work shards too.
+
+    Returns (local Fp12 pair product, combined u_planes): the combined
+    plane sums are replicated post-gather, and the raw twin's subgroup
+    check (`planes_in_subgroup`) needs them — the limb path discards
+    them because the C tier subgroup-checks on the host."""
     r_loc, lanes = pk_x.shape[0], pk_x.shape[1]
     n_loc = r_loc * lanes
     # lax.axis_size is newer-jax; psum(1, axis) is the 0.4.x idiom (static)
@@ -235,11 +248,11 @@ def _grouped_local(
     lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
     fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((2 * r_loc + per,)))
-    return _fp12_product_tree(fs)
+    return _fp12_product_tree(fs), u_planes
 
 
 def _sharded_grouped_verify(mesh_axis, *args):
-    f_loc = _grouped_local(mesh_axis, *args)
+    f_loc, _ = _grouped_local(mesh_axis, *args)
     f_all = lax.all_gather(f_loc, mesh_axis)  # (ndev, 2,3,2,32)
 
     def tail():
@@ -247,6 +260,40 @@ def _sharded_grouped_verify(mesh_axis, *args):
             return fp12.is_one(final_exponentiation_one(_fp12_product_tree(f_all)))
 
     return _tail_on_root(mesh_axis, tail)
+
+
+def _sharded_grouped_raw_verify(
+    mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid
+):
+    """Raw twin of `_sharded_grouped_verify` (zero-copy wire→mesh ingest):
+    each chip decompresses its own (r_loc, lanes, 96) slice of the raw
+    signature bytes on device, so the host never touches signature limbs
+    and the decode work itself shards with the batch. Semantics mirror
+    `grouped_verify_kernel_raw` exactly: lanes that fail to decode are
+    masked out of the pairing, any failed VALID lane forces the whole
+    verdict False (psum-combined across chips), and the combined
+    signature plane sums get the ψ-endomorphism subgroup check — the C
+    tier never saw these bytes, so the device must do its own gating."""
+    with named_scope("bls/g2_decompress"):
+        sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    fail_loc = jnp.any(valid & ~dec_ok)
+    f_loc, u_planes = _grouped_local(
+        mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y,
+        a_bits, b_bits, valid & dec_ok,
+    )
+    f_all = lax.all_gather(f_loc, mesh_axis)
+    decode_fail = lax.psum(fail_loc.astype(jnp.int32), mesh_axis) > 0
+
+    def tail():
+        with named_scope("bls/final_exp_batch"):
+            ok = fp12.is_one(
+                final_exponentiation_one(_fp12_product_tree(f_all))
+            )
+        # u_planes is replicated post-gather; running the subgroup check
+        # inside the root tail keeps it off the other chips' wall-clock
+        return ok & _planes_in_subgroup(u_planes)
+
+    return _tail_on_root(mesh_axis, tail) & ~decode_fail
 
 
 def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
@@ -292,13 +339,38 @@ def make_sharded_grouped_local_probe(mesh: Mesh, axis: str = "dp"):
     @jax.jit
     def run(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid):
         def probe(*args):
-            f_loc = _grouped_local(axis, *args)
+            f_loc, _ = _grouped_local(axis, *args)
             return lax.psum(jnp.sum(f_loc), axis)
 
         fn = _shard_map(
             probe, mesh=mesh, in_specs=(spec,) * 9, out_specs=P()
         )
         return fn(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, a_bits, b_bits, valid)
+
+    return run
+
+
+def make_sharded_grouped_raw_verifier(mesh: Mesh, axis: str = "dp"):
+    """jit-compiled sharded grouped RAW batch-verify over `mesh`:
+    signatures enter as (R, L, 96) wire bytes, root-sharded like every
+    other input, and decompress on their owning chip. Same divisibility
+    contract as `make_sharded_grouped_verifier`."""
+    ndev = mesh.devices.size
+    if (2 * HALF_BITS) % ndev != 0:
+        raise ValueError(
+            f"mesh size {ndev} must divide {2 * HALF_BITS} (constant lanes)"
+        )
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid):
+        fn = _shard_map(
+            partial(_sharded_grouped_raw_verify, axis),
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=P(),
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid)
 
     return run
 
@@ -326,6 +398,32 @@ class ShardedGroupedVerifier:
 
     def verify_grouped(self, g, a_bits, b_bits) -> bool:
         return bool(self.submit(g, a_bits, b_bits))
+
+
+class ShardedGroupedRawVerifier:
+    """Host wrapper for the sharded grouped RAW kernel: the signature
+    tensor is the (R, L, 96) wire-byte scatter straight out of
+    `_marshal_grouped(raw=True)` — no host decompression, no limb
+    conversion; `device_put` with the row sharding is the only host
+    touch before the mesh decodes."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.devices.size
+        self._run = make_sharded_grouped_raw_verifier(mesh, axis)
+        self._sharding = NamedSharding(mesh, P(axis))
+
+    def submit(self, g, sig_raw, a_bits, b_bits):
+        """Async dispatch: returns the on-device scalar verdict."""
+        put = lambda x: jax.device_put(x, self._sharding)
+        return self._run(
+            put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
+            put(sig_raw), put(a_bits), put(b_bits), put(g.valid),
+        )
+
+    def verify_grouped_raw(self, g, sig_raw, a_bits, b_bits) -> bool:
+        return bool(self.submit(g, sig_raw, a_bits, b_bits))
 
 
 # --- pk-grouped (shared-pubkey) tier -----------------------------------------
@@ -397,11 +495,11 @@ def _pk_grouped_local(
     lane_ok = ~g1.is_infinity((px, py, pz)) & ~g2.is_infinity((qx, qy, qz))
     fs = miller_loop_proj_pq((px, py, pz), (qx, qy, qz))
     fs = fp12.select(lane_ok, fs, fp12.one((r_loc + per,)))
-    return _fp12_product_tree(fs)
+    return _fp12_product_tree(fs), u_planes
 
 
 def _sharded_pk_grouped_verify(mesh_axis, *args):
-    f_loc = _pk_grouped_local(mesh_axis, *args)
+    f_loc, _ = _pk_grouped_local(mesh_axis, *args)
     f_all = lax.all_gather(f_loc, mesh_axis)
 
     def tail():
@@ -409,6 +507,31 @@ def _sharded_pk_grouped_verify(mesh_axis, *args):
             return fp12.is_one(final_exponentiation_one(_fp12_product_tree(f_all)))
 
     return _tail_on_root(mesh_axis, tail)
+
+
+def _sharded_pk_grouped_raw_verify(
+    mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid
+):
+    """Raw twin of `_sharded_pk_grouped_verify`; same decode/subgroup
+    gating as `_sharded_grouped_raw_verify` (see there)."""
+    with named_scope("bls/g2_decompress"):
+        sig_x, sig_y, dec_ok = _g2_decompress(sig_raw)
+    fail_loc = jnp.any(valid & ~dec_ok)
+    f_loc, u_planes = _pk_grouped_local(
+        mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y,
+        a_bits, b_bits, valid & dec_ok,
+    )
+    f_all = lax.all_gather(f_loc, mesh_axis)
+    decode_fail = lax.psum(fail_loc.astype(jnp.int32), mesh_axis) > 0
+
+    def tail():
+        with named_scope("bls/final_exp_batch"):
+            ok = fp12.is_one(
+                final_exponentiation_one(_fp12_product_tree(f_all))
+            )
+        return ok & _planes_in_subgroup(u_planes)
+
+    return _tail_on_root(mesh_axis, tail) & ~decode_fail
 
 
 def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str = "dp"):
@@ -435,6 +558,30 @@ def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str = "dp"):
     return run
 
 
+def make_sharded_pk_grouped_raw_verifier(mesh: Mesh, axis: str = "dp"):
+    """jit-compiled sharded pk-grouped RAW batch-verify over `mesh`:
+    signatures enter as (R, L, 96) wire bytes and decompress on their
+    owning chip. Same divisibility contract as the limb maker."""
+    ndev = mesh.devices.size
+    if (2 * HALF_BITS) % ndev != 0:
+        raise ValueError(
+            f"mesh size {ndev} must divide {2 * HALF_BITS} (constant lanes)"
+        )
+    spec = P(axis)
+
+    @jax.jit
+    def run(pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid):
+        fn = _shard_map(
+            partial(_sharded_pk_grouped_raw_verify, axis),
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=P(),
+        )
+        return fn(pk_x, pk_y, msg_x, msg_y, sig_raw, a_bits, b_bits, valid)
+
+    return run
+
+
 class ShardedPkGroupedVerifier:
     """Host wrapper for the sharded pk-grouped kernel: places (R,) pubkey
     rows + (R, L) message/signature arrays row-sharded onto the mesh."""
@@ -456,6 +603,28 @@ class ShardedPkGroupedVerifier:
 
     def verify_pk_grouped(self, g, a_bits, b_bits) -> bool:
         return bool(self.submit(g, a_bits, b_bits))
+
+
+class ShardedPkGroupedRawVerifier:
+    """Host wrapper for the sharded pk-grouped RAW kernel (wire-byte
+    signatures; see `ShardedGroupedRawVerifier`)."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        self.mesh = mesh
+        self.axis = axis
+        self.ndev = mesh.devices.size
+        self._run = make_sharded_pk_grouped_raw_verifier(mesh, axis)
+        self._sharding = NamedSharding(mesh, P(axis))
+
+    def submit(self, g, sig_raw, a_bits, b_bits):
+        put = lambda x: jax.device_put(x, self._sharding)
+        return self._run(
+            put(g.pk_x), put(g.pk_y), put(g.msg_x), put(g.msg_y),
+            put(sig_raw), put(a_bits), put(b_bits), put(g.valid),
+        )
+
+    def verify_pk_grouped_raw(self, g, sig_raw, a_bits, b_bits) -> bool:
+        return bool(self.submit(g, sig_raw, a_bits, b_bits))
 
 
 # --- bisection-verdict tier ---------------------------------------------------

@@ -1207,10 +1207,27 @@ class TpuBlsVerifier:
         return (g, sig_raw) if raw else g
 
     def _submit_pk_grouped_mesh(self, sets, plan):
-        """Sharded pk-grouped dispatch (limb marshal — see
-        `_submit_grouped_mesh` for the raw-path tradeoff)."""
+        """Sharded pk-grouped dispatch (raw wire-byte signatures when
+        device decompression is on — see `_submit_grouped_mesh`)."""
         from .mesh import NOT_SHARDED
 
+        if self._device_decompress:
+            with self.observer.stage("marshal"):
+                marshalled = self._marshal_pk_grouped(sets, plan, raw=True)
+            if marshalled is None:
+                return None
+            g, sig_raw = marshalled
+            with self.observer.stage("rand"):
+                a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            with self.observer.stage("dispatch"):
+                result = self._mesh.dispatch_pk_grouped_raw(
+                    g, sig_raw, a_bits, b_bits
+                )
+                if result is NOT_SHARDED:
+                    result = self.kernels.verify_pk_grouped_raw(
+                        g, sig_raw, a_bits, b_bits
+                    )
+            return result
         with self.observer.stage("marshal"):
             g = self._marshal_pk_grouped(sets, plan)
         if g is None:
@@ -1412,14 +1429,34 @@ class TpuBlsVerifier:
         )
 
     def _submit_grouped_mesh(self, sets, plan):
-        """Sharded grouped dispatch across the serving mesh. The mesh
-        path marshals LIMBS (C tier) rather than raw bytes: the sharded
-        kernels have no *_raw twins yet, and the pooled C-tier marshal
-        keeps the host cost bounded while every chip shares the pairing
-        work. Falls back to the single-device limb kernel if the mesh
-        shrank between the eligibility check and the dispatch."""
+        """Sharded grouped dispatch across the serving mesh. With device
+        decompression on (the default), signatures stay WIRE BYTES all
+        the way onto the mesh — the `*_raw` sharded twins decode each
+        chip's row slice on device, so the host marshal is a pure byte
+        scatter (zero-copy ingest, same contract as the single-device
+        raw path). LODESTAR_TPU_DEVICE_DECOMPRESS=0 keeps the pooled
+        C-tier limb marshal. Falls back to the matching single-device
+        kernel if the mesh shrank between the eligibility check and the
+        dispatch."""
         from .mesh import NOT_SHARDED
 
+        if self._device_decompress:
+            with self.observer.stage("marshal"):
+                marshalled = self._marshal_grouped(sets, plan, raw=True)
+            if marshalled is None:
+                return None
+            g, sig_raw = marshalled
+            with self.observer.stage("rand"):
+                a_bits, b_bits = _rand_pairs(g.valid.shape, self._custom_rng)
+            with self.observer.stage("dispatch"):
+                result = self._mesh.dispatch_grouped_raw(
+                    g, sig_raw, a_bits, b_bits
+                )
+                if result is NOT_SHARDED:
+                    result = self.kernels.verify_grouped_raw(
+                        g, sig_raw, a_bits, b_bits
+                    )
+            return result
         with self.observer.stage("marshal"):
             g = self._marshal_grouped(sets, plan)
         if g is None:

@@ -105,6 +105,26 @@ _register("LODESTAR_TPU_MESH", "str", "auto",
 _register("LODESTAR_TPU_WAITER_TIMEOUT", "float", 300.0,
           "Seconds a buffered-verifier waiter blocks on the flush "
           "thread before escalating and failing the call.")
+_register("LODESTAR_TPU_LANE_WORKERS", "int", 2,
+          "Lane-dispatcher worker threads; 2 double-buffers (host "
+          "marshal of batch N+1 overlaps device compute of batch N).")
+_register("LODESTAR_TPU_LANE_MAX_COALESCE", "int", 512,
+          "Max signature sets coalesced into one lane-dispatcher device "
+          "batch (continuous batching merges in-flight requests up to "
+          "this).")
+_register("LODESTAR_TPU_LANE_PENDING_CAP", "int", 4096,
+          "Global queued-set cap across all lanes; admission over it "
+          "evicts lowest-priority queued work (never blocks) or sheds "
+          "the incoming request.")
+_register("LODESTAR_TPU_LANE_CAP_ATTESTATION", "int", 2048,
+          "Queued-set cap for the attestation lane (shed first under "
+          "flood); 0 disables the cap.")
+_register("LODESTAR_TPU_LANE_CAP_AGGREGATE", "int", 1024,
+          "Queued-set cap for the aggregate-and-proof lane; 0 disables "
+          "the cap.")
+_register("LODESTAR_TPU_LANE_CAP_SYNC_COMMITTEE", "int", 512,
+          "Queued-set cap for the sync-committee lane; 0 disables the "
+          "cap. The block lane is never capped or shed.")
 _register("LODESTAR_TPU_IMPORT_WAIT_TIMEOUT", "float", 300.0,
           "Seconds the block-import path waits on a verification/"
           "payload future before escalating (counted in "

@@ -199,8 +199,31 @@ def test_batch_verifier_kernels_are_ledger_wrapped():
         # Miller tower ride the same seam
         ("_final_exp_batch", "final_exp_batch"),
         ("_miller_pallas", "miller_pallas"),
+        # ISSUE 15: the zero-copy wire→device kernels (on-chip signature
+        # decode) are the DEFAULT serving path — their compiles must be
+        # first-class ledger events too
+        ("_batch_raw", "batch_raw"),
+        ("_grouped_raw", "grouped_raw"),
+        ("_pk_grouped_raw", "pk_grouped_raw"),
     ):
         assert getattr(bv, attr).__compile_ledger_kernel__ == kernel
+
+
+def test_mesh_raw_twin_submit_is_ledger_wrapped():
+    """ISSUE 15: the mesh dispatcher's raw-twin verifiers ride the same
+    `_ledger_wrap_submit` seam as the limb twins — each (kind, shape,
+    chips) raw verifier is one shard_map compile, recorded under the
+    `sharded_grouped_raw` / `sharded_pk_grouped_raw` kernel names."""
+    from lodestar_tpu.parallel.mesh import _ledger_wrap_submit
+
+    class _V:
+        def submit(self, *a):
+            return True
+
+    for kind in ("grouped_raw", "pk_grouped_raw"):
+        v = _V()
+        _ledger_wrap_submit(v, kind, (16, 8), (0, 1))
+        assert v.submit.__compile_ledger_kernel__ == f"sharded_{kind}"
 
 
 # -- flight recorder --------------------------------------------------------

@@ -1,0 +1,178 @@
+"""Malformed-encoding differential fuzz across the three decompress
+tiers (ISSUE 15 satellite): for every hostile byte pattern the CPU
+oracle, the single-device raw kernel, and the NEW sharded-raw twin must
+agree on the batch verdict — bit-identical, same random coefficients.
+
+Corpus: bad flag bits (compression cleared), wrong y sign, x ≥ p,
+off-curve / non-residue x, infinity-with-payload, and a valid-encoding
+point outside the G2 subgroup (caught only by the plane check).
+
+COMPILE DISCIPLINE: ONE grouped shape (8 rows × 4 lanes) shared by every
+scenario — two deep compiles total (single-device grouped-raw kernel +
+the 8-chip sharded grouped-raw twin), everything after is dispatch-only.
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.bls import api as bls
+from lodestar_tpu.bls.curve import B2, PointG2, g2_from_bytes, g2_to_bytes
+from lodestar_tpu.bls.fields import P, Fq2
+from lodestar_tpu.chain.bls_verifier import CpuBlsVerifier
+from lodestar_tpu.parallel.verifier import TpuBlsVerifier, _rand_pairs
+
+# deep-kernel compiles (decompress embeds 380-step pow scans): slow tier
+pytestmark = pytest.mark.slow
+
+_COUNTER = [0]
+
+
+def _det_rng():
+    _COUNTER[0] += 1
+    return (0x9E3779B97F4A7C15 * _COUNTER[0]) & ((1 << 64) - 1)
+
+
+ROWS, LANES = 8, 2  # 8 shared roots × 2 signers → 8×4 grouped plan
+
+
+def _make_sets():
+    """8 committees × 2 signers, shared root per committee — groups into
+    the module's single 8-row plan."""
+    sets = []
+    for row in range(ROWS):
+        root = bytes([row ^ 0x5A]) * 32
+        for j in range(LANES):
+            sk = bls.interop_secret_key(row * LANES + j)
+            sets.append(
+                bls.SignatureSet(
+                    pubkey=sk.to_public_key(),
+                    message=root,
+                    signature=sk.sign(root).to_bytes(),
+                )
+            )
+    return sets
+
+
+def _non_subgroup_point() -> PointG2:
+    x = Fq2.from_ints(5, 1)
+    while True:
+        y2 = x * x * x + B2
+        y = y2.sqrt()
+        if y is not None:
+            pt = PointG2(x, y, Fq2.one())
+            if not pt.is_in_subgroup():
+                return pt
+        x = x + Fq2.from_ints(1, 0)
+
+
+def _clear_compression(b: bytes) -> bytes:
+    raw = bytearray(b)
+    raw[0] &= 0x7F
+    return bytes(raw)
+
+
+def _flip_y_sign(b: bytes) -> bytes:
+    raw = bytearray(b)
+    raw[0] ^= 0x20
+    return bytes(raw)
+
+
+def _x_ge_p(b: bytes) -> bytes:
+    raw = bytearray(b)
+    pb = bytearray(P.to_bytes(48, "big"))
+    pb[0] |= 0x80 | (raw[0] & 0x20)  # x_c1 = p, flags preserved
+    raw[:48] = pb
+    return bytes(raw)
+
+
+def _infinity_with_payload(_b: bytes) -> bytes:
+    return bytes([0xC0, 0x01]) + b"\x00" * 94
+
+
+def _off_curve(b: bytes) -> bytes:
+    """Walk the last x byte until the oracle refuses to decompress —
+    either y² = x³ + 4(1+u) has no root (non-residue) or the point is
+    otherwise unparseable."""
+    raw = bytearray(b)
+    while True:
+        raw[95] = (raw[95] + 1) % 256
+        try:
+            g2_from_bytes(bytes(raw))
+        except Exception:
+            return bytes(raw)
+
+
+def _non_subgroup(_b: bytes) -> bytes:
+    return g2_to_bytes(_non_subgroup_point())
+
+
+CORPUS = [
+    ("clear_compression_flag", _clear_compression),
+    ("wrong_y_sign", _flip_y_sign),
+    ("x_ge_p", _x_ge_p),
+    ("infinity_with_payload", _infinity_with_payload),
+    ("off_curve_non_residue", _off_curve),
+    ("non_subgroup_point", _non_subgroup),
+]
+
+
+@pytest.fixture(scope="module")
+def host():
+    """Single-device raw verifier: marshal (zero-copy signature bytes) +
+    the unsharded grouped-raw parity kernel."""
+    return TpuBlsVerifier(
+        buckets=(16,), grouped_configs=((ROWS, 4),), rng=_det_rng,
+        device_decompress=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def sharded_raw(cpu_mesh):
+    from lodestar_tpu.parallel.sharded import ShardedGroupedRawVerifier
+
+    return ShardedGroupedRawVerifier(cpu_mesh)
+
+
+@pytest.fixture(scope="module")
+def cpu_oracle():
+    return CpuBlsVerifier()
+
+
+def _verdicts(host, sharded_raw, cpu_oracle, sets):
+    """(cpu, single_device_raw, sharded_raw) verdicts for one batch, the
+    device pair sharing one set of random coefficients."""
+    cpu = cpu_oracle.verify_signature_sets(sets)
+    plan = host._plan_groups(sets)
+    assert plan is not None, "corpus must keep its grouped shape"
+    marshalled = host._marshal_grouped(sets, plan, raw=True)
+    assert marshalled is not None
+    g, sig_raw = marshalled
+    a_bits, b_bits = _rand_pairs(g.valid.shape, host._rng)
+    single = bool(host.kernels.verify_grouped_raw(g, sig_raw, a_bits, b_bits))
+    sharded = bool(sharded_raw.submit(g, sig_raw, a_bits, b_bits))
+    return cpu, single, sharded
+
+
+def test_valid_baseline_all_tiers_accept(host, sharded_raw, cpu_oracle):
+    cpu, single, sharded = _verdicts(host, sharded_raw, cpu_oracle, _make_sets())
+    assert (cpu, single, sharded) == (True, True, True)
+
+
+@pytest.mark.parametrize("name,mutate", CORPUS)
+@pytest.mark.parametrize("target", [0, ROWS * LANES - 1])
+def test_malformed_encoding_differential(
+    host, sharded_raw, cpu_oracle, name, mutate, target
+):
+    """Every hostile pattern — injected at the first and the last lane so
+    it lands on the first and the last CHIP of the sharded grid — must be
+    rejected identically by all three tiers."""
+    sets = _make_sets()
+    sets[target] = bls.SignatureSet(
+        pubkey=sets[target].pubkey,
+        message=sets[target].message,
+        signature=mutate(sets[target].signature),
+    )
+    cpu, single, sharded = _verdicts(host, sharded_raw, cpu_oracle, sets)
+    assert cpu is False, name
+    assert single == cpu, name
+    assert sharded == single, name

@@ -110,6 +110,41 @@ def test_dispatch_grouped_routes_and_counts():
     assert snap["chip_dispatches"] == {"0": 2, "1": 2, "2": 2, "3": 2}
 
 
+def test_dispatch_grouped_raw_routes_and_counts():
+    """ISSUE 15: the zero-copy raw twins route through the same verifier
+    cache and chip accounting as the limb kernels, under their own
+    (kind, shape) cache keys."""
+    calls = []
+    obs = PipelineMetrics()
+    d = _dispatcher(4, observer=obs, calls=calls)
+    g = _FakeGrouped(8, 64)
+    assert d.dispatch_grouped_raw(g, None, None, None) is True
+    assert len(calls) == 1 and calls[0].kind == "grouped_raw"
+    assert calls[0].devices == ["dev0", "dev1", "dev2", "dev3"]
+    assert d.dispatch_pk_grouped_raw(g, None, None, None) is True
+    assert len(calls) == 2 and calls[1].kind == "pk_grouped_raw"
+    # same shapes again: cached verifiers, no new factory calls
+    assert d.dispatch_grouped_raw(g, None, None, None) is True
+    assert d.dispatch_pk_grouped_raw(g, None, None, None) is True
+    assert len(calls) == 2
+    assert calls[0].submits == 2 and calls[1].submits == 2
+    snap = obs.mesh_snapshot()
+    assert snap["chip_dispatches"] == {"0": 4, "1": 4, "2": 4, "3": 4}
+
+
+def test_dispatch_raw_refuses_indivisible_and_tiny():
+    d = _dispatcher(4)
+    assert d.dispatch_grouped_raw(
+        _FakeGrouped(9, 64), None, None, None
+    ) is NOT_SHARDED
+    assert d.dispatch_pk_grouped_raw(
+        _FakeGrouped(6, 8), None, None, None
+    ) is NOT_SHARDED
+    assert _dispatcher(1).dispatch_grouped_raw(
+        _FakeGrouped(8, 64), None, None, None
+    ) is NOT_SHARDED
+
+
 def test_dispatch_refuses_indivisible_and_tiny_batches():
     d = _dispatcher(4)
     assert d.dispatch_grouped(_FakeGrouped(9, 64), None, None) is NOT_SHARDED

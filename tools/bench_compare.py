@@ -68,6 +68,10 @@ REQUIRED_GATED_KEYS = (
     # the mesh-native serving rate (round-7 tentpole): the grouped kernel
     # through the production mesh dispatcher on this host's mesh
     "sharded_grouped_sets_per_sec",
+    # zero-copy wire→verdict through the mesh raw twins (ISSUE 15):
+    # the facade with a mesh attached, signature bytes decompressed
+    # on-device per chip — the e2e acceptance row for mesh ingest
+    "e2e_mesh_raw_sets_per_sec",
 )
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")

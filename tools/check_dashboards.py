@@ -59,6 +59,13 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_bls_mesh_evictions_total",
         "lodestar_bls_mesh_readmissions_total",
         "lodestar_bls_mesh_chip_dispatch_total",
+        # lane-dispatcher families (ISSUE 15): flood load-shedding and
+        # continuous-batching health — a node silently shedding
+        # attestations (or worse, coalescing nothing) must be visible
+        "lodestar_bls_lane_depth",
+        "lodestar_bls_lane_shed_total",
+        "lodestar_bls_lane_coalesced_sets",
+        "lodestar_bls_lane_overlap_fraction",
         # compile-ledger families (ISSUE 11): every XLA compile is a
         # measured event — the compile tax that killed two driver rounds
         # must be on the dashboard, not only in /debug/compiles

@@ -83,6 +83,7 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
         _example_grouped,
         _example_pk_grouped,
     )
+    from lodestar_tpu.parallel.mesh import mesh_divisor
     from lodestar_tpu.parallel.verifier import BatchVerifier, SetArrays
 
     buckets = (4, 16, 64, 128) + ((4096,) if include_bench else ())
@@ -193,6 +194,43 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
             print(f"pk-grouped raw {rows}x{lanes}: "
                   f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
         timeline().mark(f"rung_pk_grouped_{rows}x{lanes}")
+    # sharded-raw ladder (ISSUE 15): with >1 visible device the mesh
+    # dispatcher routes raw gossip bytes to the on-mesh decompression
+    # twins by default — warm them for every production grouped shape the
+    # mesh can shard (rows divisible by the mesh size), or a cold compile
+    # lands on the first gossip batch after a restart
+    n_mesh = mesh_divisor(len(jax.devices()))
+    if device_decompress and n_mesh >= 2:
+        from jax.sharding import Mesh
+
+        from lodestar_tpu.parallel.sharded import (
+            ShardedGroupedRawVerifier,
+            ShardedPkGroupedRawVerifier,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:n_mesh]), axis_names=("dp",))
+        sgr = ShardedGroupedRawVerifier(mesh)
+        for rows, lanes in grouped:
+            if rows % n_mesh:
+                continue
+            g, a_bits, b_bits, sig_raw = _example_grouped(rows, lanes, raw=True)
+            t0 = time.monotonic()
+            ok = bool(sgr.submit(g, sig_raw, a_bits, b_bits))
+            print(f"sharded-raw grouped {rows}x{lanes} /{n_mesh}: "
+                  f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
+            timeline().mark(f"rung_sharded_raw_{rows}x{lanes}")
+        spgr = ShardedPkGroupedRawVerifier(mesh)
+        for rows, lanes in pk_grouped:
+            if rows % n_mesh:
+                continue
+            g, a_bits, b_bits, sig_raw = _example_pk_grouped(
+                rows, lanes, raw=True
+            )
+            t0 = time.monotonic()
+            ok = bool(spgr.submit(g, sig_raw, a_bits, b_bits))
+            print(f"sharded-raw pk-grouped {rows}x{lanes} /{n_mesh}: "
+                  f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
+            timeline().mark(f"rung_sharded_raw_pk_{rows}x{lanes}")
     # the ladder is the serving contract: every production shape compiled
     # means a node restarting against this cache is serving-ready here
     t_ready = timeline().mark_serving_ready()
