@@ -759,6 +759,17 @@ def main() -> None:
 
     em.add_section("compile_ledger", lambda: ledger().snapshot())
     em.add_section("startup", lambda: timeline().snapshot())
+    # SLO verdicts (round 16): every emission carries the burn state of
+    # the committed objectives — bench_compare gates on a burning one by
+    # NAME instead of a raw-number diff
+    from lodestar_tpu.observability import device_ledger, slo
+
+    slo.install(pipeline)
+    em.add_section("slo", slo.snapshot_or_none)
+    # device-time & memory ledger (round 16): busy/idle/overlap seconds
+    # by lane x kernel x chip + memory watermarks; read at emit time, so
+    # the watchdog's rc=124 document shows what the chips were doing
+    em.add_section("device", device_ledger.ledger().snapshot)
     # per-run artifact, written inside emit() so even the watchdog's
     # os._exit(124) path leaves compile_ledger.json behind
     em.on_emit.append(

@@ -43,6 +43,7 @@ from .bls_verifier import (
     ThreadBufferedVerifier,
     _verify_merged,
 )
+from ..observability import device_ledger
 
 __all__ = ["BlsLaneDispatcher", "BlsShedError", "LANES", "DEFAULT_LANE"]
 
@@ -348,9 +349,15 @@ class BlsLaneDispatcher(ThreadBufferedVerifier):
         self.pipeline.lane_overlap(overlapped)
         t0 = time.monotonic()
         try:
-            per_request = _verify_merged(
-                self.verifier, [e[0] for e in entries], self.metrics, self.prom
-            )
+            # device-time attribution: entries drain in strict priority
+            # order, so the batch is charged to its highest-priority lane
+            with device_ledger.ledger().lane_flush(
+                entries[0][3], overlapped=overlapped
+            ):
+                per_request = _verify_merged(
+                    self.verifier, [e[0] for e in entries], self.metrics,
+                    self.prom,
+                )
         except Exception:
             per_request = [False] * len(entries)
             from ..utils.logger import get_logger

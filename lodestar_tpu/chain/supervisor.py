@@ -293,6 +293,12 @@ class SupervisedBlsVerifier:
                 start_canary = False
         if start_canary:
             self._start_canary_thread()
+        # a device failure is exactly the event SLO burn state exists
+        # for: re-evaluate now (rate-limited, never raises) instead of
+        # waiting for the next scrape
+        from ..observability import slo
+
+        slo.poke()
 
     def _record_device_success(self) -> None:
         with self._lock:

@@ -27,6 +27,15 @@ profiler + lifecycle-trace control surface:
                           (serving-ready SLO marks), and the flight-
                           recorder ring (?limit=K recent events)
                           (observability/compile_ledger.py)
+    GET /debug/slo        SLO engine state: every committed objective's
+                          burn-rate windows, error-budget remaining and
+                          ok/burning verdict (observability/slo.py);
+                          nodes without an installed engine report
+                          wired: false
+    GET /debug/device     device-time & memory ledger: busy/idle/overlap
+                          device-seconds by lane x kernel x chip plus
+                          the sampled per-chip memory watermarks
+                          (observability/device_ledger.py)
 
 (GET also accepted on the profiler routes — operator curl ergonomics.)
 The profiler hooks default to `observability.trace`, the same process-
@@ -56,6 +65,8 @@ class MetricsServer:
         breaker=None,
         mesh=None,
         lanes=None,
+        slo=None,
+        device=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -172,6 +183,45 @@ class MetricsServer:
                         except Exception as e:
                             self._send_json(500, {"error": str(e)})
                             return
+                    if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
+                    return
+                if route == "/debug/slo":
+                    # slo = zero-arg callable returning the engine's
+                    # snapshot(), None while no engine is installed
+                    # (defaults to the process-wide singleton)
+                    snap = None
+                    provider = slo
+                    if provider is None:
+                        from ..observability import slo as slo_mod
+
+                        provider = slo_mod.snapshot_or_none
+                    try:
+                        snap = provider()
+                    except Exception as e:
+                        self._send_json(500, {"error": str(e)})
+                        return
+                    if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
+                    return
+                if route == "/debug/device":
+                    # device = zero-arg callable returning the device
+                    # ledger's snapshot() (defaults to the process-wide
+                    # singleton — always wired, attribution may be empty)
+                    provider = device
+                    if provider is None:
+                        from ..observability import device_ledger
+
+                        provider = device_ledger.ledger().snapshot
+                    try:
+                        snap = provider()
+                    except Exception as e:
+                        self._send_json(500, {"error": str(e)})
+                        return
                     if snap is None:
                         self._send_json(200, {"wired": False})
                         return

@@ -179,6 +179,11 @@ class BeaconNode:
             )
             self.api_server.start()
             self.log.info("REST API on :%d", self.api_server.port)
+        # SLO engine over the node's live pipeline: /debug/slo, the
+        # lodestar_slo_* families and supervisor pokes all read it
+        from ..observability import device_ledger, slo
+
+        slo.install(self.metrics.pipeline)
         if opts.metrics:
             self.metrics_server = MetricsServer(
                 self.metrics.registry, port=opts.metrics_port,
@@ -194,6 +199,8 @@ class BeaconNode:
                     else None
                 ),
                 lanes=self.metrics.pipeline.lanes_snapshot,
+                slo=slo.snapshot_or_none,
+                device=device_ledger.ledger().snapshot,
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)

@@ -33,6 +33,16 @@ threaded through the verifier stack:
 - `flight_recorder` — bounded black-box ring of dispatch/compile/
   breaker/mesh/phase events, dumped into every bench emission (watchdog
   and SIGTERM paths included) so an rc=124 round leaves a post-mortem.
+- `slo` — declarative SLO engine (PR 16): objectives from the committed
+  `dashboards/slo_rules.json` evaluated in-process over PipelineMetrics
+  with Google-SRE error budgets and multi-window (5 m/1 h) burn-rate
+  states; exports `lodestar_slo_*`, serves `/debug/slo`, embeds in
+  bench emissions and gates `tools/bench_compare.py`.
+- `device_ledger` — device-time & memory ledger (PR 16): busy/idle/
+  overlap device-seconds attributed by lane x kernel x chip from the
+  lane dispatcher's flush worker and the mesh dispatch hooks, plus a
+  low-rate jax memory sampler with per-chip high watermarks; serves
+  `/debug/device` and lands in the rc=124 post-mortem.
 """
 
 from .stages import (  # noqa: F401
@@ -57,6 +67,8 @@ from .compile_ledger import (  # noqa: F401
     timeline,
 )
 from .flight_recorder import FlightRecorder, recorder  # noqa: F401
+from .slo import SloEngine  # noqa: F401
+from .device_ledger import DeviceLedger  # noqa: F401
 from .spans import (  # noqa: F401
     MILESTONES,
     Tracer,

@@ -335,6 +335,66 @@ class PipelineMetrics:
             "compile-cache dir set/unset)",
             label_names=BUILD_INFO_LABELS,
         )
+        # SLO engine families (round 16): the judgment layer over
+        # everything above — objectives from dashboards/slo_rules.json
+        # evaluated with Google-SRE error budgets and multi-window
+        # (5 m / 1 h) burn rates (observability/slo.py feeds them)
+        self.slo_burning = r.gauge(
+            "lodestar_slo_burning",
+            "1 while an SLO objective is burning its error budget on "
+            "BOTH the short and long window (alert on == 1)",
+            label_names=("objective",),
+        )
+        self.slo_budget_remaining = r.gauge(
+            "lodestar_slo_budget_remaining_fraction",
+            "fraction of an objective's error budget left since the "
+            "engine started (1 = untouched, 0 = exhausted)",
+            label_names=("objective",),
+        )
+        self.slo_burn_rate = r.gauge(
+            "lodestar_slo_burn_rate",
+            "error-budget burn rate per evaluation window (1.0 = burning "
+            "exactly the sustainable rate; zero-tolerance objectives "
+            "report raw bad-event counts)",
+            label_names=("objective", "window"),
+        )
+        self.slo_evaluations = r.counter(
+            "lodestar_slo_evaluations_total",
+            "SLO engine evaluation passes (scrapes, bench sections, "
+            "supervisor pokes)",
+        )
+        # device-time & memory ledger families (round 16): where
+        # device-seconds and HBM bytes actually go, by lane x kernel x
+        # chip (observability/device_ledger.py feeds them)
+        self.device_dispatch_seconds = r.counter(
+            "lodestar_tpu_device_dispatch_seconds_total",
+            "busy device-seconds attributed per lane x kernel x chip "
+            "(each participating chip accrues the full dispatch time)",
+            label_names=("lane", "kernel", "chip"),
+        )
+        self.device_overlap_seconds = r.counter(
+            "lodestar_tpu_device_overlap_seconds_total",
+            "device-seconds spent while another dispatch was already in "
+            "flight (double-buffering overlap), same key as dispatch time",
+            label_names=("lane", "kernel", "chip"),
+        )
+        self.device_idle_wall = r.gauge(
+            "lodestar_tpu_device_idle_wall_seconds",
+            "wall seconds with NO dispatch in flight since the device "
+            "ledger started (refreshed on snapshot)",
+        )
+        self.device_memory = r.gauge(
+            "lodestar_tpu_device_memory_bytes",
+            "sampled jax device memory by chip and kind "
+            "(in_use/peak/limit/live_buffers)",
+            label_names=("chip", "kind"),
+        )
+        self.device_memory_watermark = r.gauge(
+            "lodestar_tpu_device_memory_watermark_bytes",
+            "high watermark of sampled in-use device memory per chip "
+            "(monotonic within a process)",
+            label_names=("chip",),
+        )
         # device-busy sampler state: busy seconds accumulate per resolve,
         # the fraction is re-sampled over >=1 s wall windows
         self._busy_lock = threading.Lock()
@@ -355,6 +415,10 @@ class PipelineMetrics:
         from .compile_ledger import ledger as _compile_ledger
 
         _compile_ledger().attach(self)
+        # same fan-out contract for the device-time & memory ledger
+        from .device_ledger import ledger as _device_ledger
+
+        _device_ledger().attach(self)
 
     # -- stage timers -------------------------------------------------------
 
@@ -453,6 +517,9 @@ class PipelineMetrics:
         self._lane_depths_fn = fn
         for lane in ("block", "sync_committee", "aggregate", "attestation"):
             self.lane_depth.set(0, lane=lane)
+        # initialize the overlap gauge too: a scrape before the first
+        # flood must see 0.0, not an absent series (round-16 satellite)
+        self.lane_overlap_fraction.set(0.0)
 
     def lane_depth_set(self, lane: str, n_sets: int) -> None:
         self.lane_depth.set(n_sets, lane=lane)
@@ -525,6 +592,44 @@ class PipelineMetrics:
             k: str(info.get(k, "unknown")) for k in BUILD_INFO_LABELS
         }
         self.build_info.set(1, **labels)
+
+    # -- SLO engine ---------------------------------------------------------
+
+    def slo_report(self, objective: str, burning: bool,
+                   budget_remaining: float, burn_short: float,
+                   burn_long: float) -> None:
+        """One objective's state after an engine evaluation (the SLO
+        engine fans this out — don't call directly)."""
+        self.slo_burning.set(1 if burning else 0, objective=objective)
+        self.slo_budget_remaining.set(budget_remaining, objective=objective)
+        self.slo_burn_rate.set(burn_short, objective=objective, window="short")
+        self.slo_burn_rate.set(burn_long, objective=objective, window="long")
+
+    def slo_evaluated(self) -> None:
+        self.slo_evaluations.inc()
+
+    # -- device-time & memory ledger ----------------------------------------
+
+    def device_dispatch_time(self, lane: str, kernel: str, chip: str,
+                             busy_s: float, overlap_s: float = 0.0) -> None:
+        """One dispatch's attributed device time for one chip (the device
+        ledger fans this out — don't call directly)."""
+        self.device_dispatch_seconds.inc(
+            busy_s, lane=lane, kernel=kernel, chip=chip
+        )
+        if overlap_s:
+            self.device_overlap_seconds.inc(
+                overlap_s, lane=lane, kernel=kernel, chip=chip
+            )
+
+    def device_idle(self, idle_s: float) -> None:
+        self.device_idle_wall.set(idle_s)
+
+    def device_memory_sample(self, chip: str, kind: str, value: float) -> None:
+        self.device_memory.set(value, chip=chip, kind=kind)
+
+    def device_memory_watermark_set(self, chip: str, value: float) -> None:
+        self.device_memory_watermark.set(value, chip=chip)
 
     # -- queue / flush ------------------------------------------------------
 

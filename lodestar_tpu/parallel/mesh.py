@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 
-from ..observability import trace
+from ..observability import device_ledger, trace
 from ..observability.stages import PipelineMetrics, default_pipeline
 from ..testing import faults as _faults
 from ..utils.logger import get_logger
@@ -169,7 +169,8 @@ class BlsMeshDispatcher:
             return NOT_SHARDED
         v, chips = self._verifier("grouped", g.pk_x.shape[:2])
         self._pre_dispatch("grouped", chips)
-        with trace.annotation(f"bls/mesh/grouped[{len(chips)}]"):
+        with trace.annotation(f"bls/mesh/grouped[{len(chips)}]"), \
+                device_ledger.ledger().dispatch("grouped", chips):
             return v.submit(g, a_bits, b_bits)
 
     def dispatch_grouped_raw(self, g, sig_raw, a_bits, b_bits):
@@ -180,7 +181,8 @@ class BlsMeshDispatcher:
             return NOT_SHARDED
         v, chips = self._verifier("grouped_raw", g.pk_x.shape[:2])
         self._pre_dispatch("grouped_raw", chips)
-        with trace.annotation(f"bls/mesh/grouped_raw[{len(chips)}]"):
+        with trace.annotation(f"bls/mesh/grouped_raw[{len(chips)}]"), \
+                device_ledger.ledger().dispatch("grouped_raw", chips):
             return v.submit(g, sig_raw, a_bits, b_bits)
 
     def dispatch_pk_grouped(self, g, a_bits, b_bits):
@@ -190,7 +192,8 @@ class BlsMeshDispatcher:
             return NOT_SHARDED
         v, chips = self._verifier("pk_grouped", g.msg_x.shape[:2])
         self._pre_dispatch("pk_grouped", chips)
-        with trace.annotation(f"bls/mesh/pk_grouped[{len(chips)}]"):
+        with trace.annotation(f"bls/mesh/pk_grouped[{len(chips)}]"), \
+                device_ledger.ledger().dispatch("pk_grouped", chips):
             return v.submit(g, a_bits, b_bits)
 
     def dispatch_pk_grouped_raw(self, g, sig_raw, a_bits, b_bits):
@@ -201,7 +204,8 @@ class BlsMeshDispatcher:
             return NOT_SHARDED
         v, chips = self._verifier("pk_grouped_raw", g.msg_x.shape[:2])
         self._pre_dispatch("pk_grouped_raw", chips)
-        with trace.annotation(f"bls/mesh/pk_grouped_raw[{len(chips)}]"):
+        with trace.annotation(f"bls/mesh/pk_grouped_raw[{len(chips)}]"), \
+                device_ledger.ledger().dispatch("pk_grouped_raw", chips):
             return v.submit(g, sig_raw, a_bits, b_bits)
 
     def dispatch_bisect(self, arrs, r_bits):
@@ -214,7 +218,8 @@ class BlsMeshDispatcher:
             return NOT_SHARDED
         v, chips = self._verifier("bisect", (lanes,))
         self._pre_dispatch("bisect", chips)
-        with trace.annotation(f"bls/mesh/bisect[{len(chips)}]"):
+        with trace.annotation(f"bls/mesh/bisect[{len(chips)}]"), \
+                device_ledger.ledger().dispatch("bisect", chips):
             return v.submit(arrs, r_bits)
 
     # -- failure policy -----------------------------------------------------

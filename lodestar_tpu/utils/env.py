@@ -162,6 +162,16 @@ _register("LODESTAR_TPU_FLIGHT_RECORDER_SIZE", "int", 256,
           "Bounded event ring of the black-box flight recorder "
           "(observability/flight_recorder.py); dumped into bench "
           "documents and /debug/compiles.")
+_register("LODESTAR_TPU_SLO_RULES", "str", None,
+          "Path to the SLO objectives file (observability/slo.py); "
+          "unset = the committed dashboards/slo_rules.json.")
+_register("LODESTAR_TPU_SLO_POKE_S", "float", 1.0,
+          "Min seconds between event-driven SLO re-evaluations "
+          "(slo.poke() from the supervisor failure path); 0 = every "
+          "poke evaluates.")
+_register("LODESTAR_TPU_DEVICE_LEDGER_MEM_SAMPLE_S", "float", 10.0,
+          "Min seconds between jax device-memory samples in the device "
+          "ledger (observability/device_ledger.py); 0 = sampler off.")
 
 # --- compile containment --------------------------------------------------
 _register("LODESTAR_TPU_COMPILE_CACHE", "str", None,

@@ -299,3 +299,108 @@ def test_single_parseable_round_exits_zero(tmp_path, capsys):
     assert mod.main(["--dir", str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "1 parseable round" in out
+
+
+# --- SLO verdict gating (ISSUE 16) -------------------------------------------
+
+
+def _slo(*states):
+    """An `slo` bench section with objectives o0..oN in the given states."""
+    return {"slo": {"objectives": [
+        {"name": f"o{i}", "state": s} for i, s in enumerate(states)
+    ]}}
+
+
+def test_burning_objective_fails_gate_by_name(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 9000.0, extra=_slo("ok", "ok"))
+    _round(tmp_path, 2, 9100.0, extra=_slo("ok", "burning"))
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    # the verdict delta is printed per objective, and the failure names
+    # the burning objective rather than a raw-number diff
+    assert "slo:o1  ok -> burning" in out
+    assert "BURNING" in out
+    assert "slo:o1 (error budget burning)" in out
+    assert "error budget" in out.split("FAIL:")[1]
+
+
+def test_slo_ok_rounds_print_deltas_and_pass(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 9000.0, extra=_slo("burning", "ok"))
+    _round(tmp_path, 2, 9100.0, extra=_slo("ok", "ok"))  # recovered
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "slo:o0  burning -> ok" in out
+    assert "OK: no gated key regressed past the threshold" in out
+
+
+def test_slo_only_mode_gates_exclusively_on_verdicts(tmp_path, capsys):
+    mod = _load()
+    _round(tmp_path, 1, 9000.0, extra=_slo("ok"))
+    _round(tmp_path, 2, 1000.0, extra=_slo("ok"))  # 9x numeric drop
+    # the numeric gate fails this history...
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    capsys.readouterr()
+    # ...but --slo-only judges only the budgets
+    assert mod.main(["--dir", str(tmp_path), "--slo-only"]) == 0
+    out = capsys.readouterr().out
+    assert "numeric thresholds skipped" in out
+    assert "OK: no SLO objective is burning its error budget" in out
+    _round(tmp_path, 3, 9000.0, extra=_slo("burning"))
+    assert mod.main(["--dir", str(tmp_path), "--slo-only"]) == 1
+    capsys.readouterr()
+
+
+def test_rounds_predating_slo_engine_never_gate(tmp_path, capsys):
+    """Committed history predates the engine: no `slo` section means no
+    verdicts and no gating — in both modes."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    _round(tmp_path, 2, 9100.0)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "no SLO verdicts in either round" in capsys.readouterr().out
+    assert mod.main(["--dir", str(tmp_path), "--slo-only"]) == 0
+    capsys.readouterr()
+
+
+def test_degraded_and_timed_out_rounds_report_burn_state(tmp_path, capsys):
+    """ISSUE 16 satellite: a skipped round still says what its budgets
+    looked like when it died (the skip notes themselves are unchanged)."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0, extra=_slo("ok"))
+    _round(tmp_path, 2, 900.0, extra={
+        "supervisor": {"degraded": True, "breaker_state": 2},
+        **_slo("burning", "ok"),
+    })
+    _round(tmp_path, 3, 1200.0, extra={
+        "timed_out": True, **_slo("ok", "ok"),
+    })
+    _round(tmp_path, 4, 8800.0, extra=_slo("ok"))
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "DEGRADED" in out and "timed out mid-run" in out
+    assert "r02 burn state — BURNING: o0" in out
+    assert "r03 burn state — all 2 objectives ok" in out
+    # a skipped round with no slo section reports n/a, not a crash
+    _round(tmp_path, 5, 1000.0, extra={"timed_out": True})
+    _round(tmp_path, 6, 8700.0, extra=_slo("ok"))
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "r05 burn state — n/a" in capsys.readouterr().out
+
+
+def test_slo_from_details_augments_latest_round(tmp_path, capsys):
+    """bench_details.json carries the slo section for the newest round
+    when the driver's BENCH_r file predates the engine's emission."""
+    mod = _load()
+    _round(tmp_path, 1, 9000.0)
+    _round(tmp_path, 2, 9100.0)
+    details = tmp_path / "bench_details.json"
+    details.write_text(json.dumps({
+        "metric": "bls_signature_sets_verified_per_sec",
+        "value": 9100.0,
+        **_slo("ok", "burning"),
+    }))
+    assert mod.main(["--dir", str(tmp_path), "--details", str(details)]) == 1
+    out = capsys.readouterr().out
+    assert "slo:o1  n/a -> burning" in out

@@ -1,0 +1,263 @@
+"""Device-time & memory ledger tests (ISSUE 16 tentpole B): interval
+attribution through the real lane dispatcher (>= 95% of device wall
+time accounted), nested-dispatch double-count suppression, overlap
+accounting, memory-watermark monotonicity, the /debug/device endpoint,
+and the rc=124 post-mortem inclusion (the watchdog's emission must
+carry the device section).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.chain.bls_verifier import MockBlsVerifier
+from lodestar_tpu.chain.dispatcher import BlsLaneDispatcher
+from lodestar_tpu.observability import device_ledger
+from lodestar_tpu.observability.device_ledger import DeviceLedger
+from lodestar_tpu.observability.stages import PipelineMetrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    device_ledger._reset_for_tests()
+    yield
+    device_ledger._reset_for_tests()
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --- interval attribution -----------------------------------------------------
+
+
+def test_lane_flush_attributes_stub_verifier_time():
+    clock = FakeClock()
+    led = DeviceLedger(clock=clock)
+    with led.lane_flush("block"):
+        clock.advance(0.25)
+    snap = led.snapshot()
+    assert snap["busy_wall_s"] == pytest.approx(0.25)
+    assert snap["attributed_busy_s"] == pytest.approx(0.25)
+    (row,) = snap["attributed"]
+    assert (row["lane"], row["kernel"], row["chip"]) == ("block", "lane_flush", "0")
+    assert row["overlap_s"] == 0.0
+
+
+def test_nested_dispatch_suppresses_lane_flush_double_count():
+    """A lane_flush whose body reached the mesh attributes ONLY the inner
+    dispatch (per participating chip, under the flush's lane) — never
+    both the flush and the dispatch for the same interval."""
+    clock = FakeClock()
+    led = DeviceLedger(clock=clock)
+    with led.lane_flush("attestation"):
+        with led.dispatch("grouped", (0, 1)):
+            clock.advance(1.0)
+    snap = led.snapshot()
+    rows = {(r["lane"], r["kernel"], r["chip"]): r["busy_s"]
+            for r in snap["attributed"]}
+    assert rows == {
+        ("attestation", "grouped", "0"): pytest.approx(1.0),
+        ("attestation", "grouped", "1"): pytest.approx(1.0),
+    }
+    # busy WALL is the union of intervals: 1 s, not 2 chip-seconds
+    assert snap["busy_wall_s"] == pytest.approx(1.0)
+    assert snap["attributed_busy_s"] == pytest.approx(2.0)
+    assert snap["dispatches"] == 1
+
+
+def test_dispatch_outside_lane_flush_is_unlabeled():
+    clock = FakeClock()
+    led = DeviceLedger(clock=clock)
+    with led.dispatch("bisect", (0,)):
+        clock.advance(0.5)
+    (row,) = led.snapshot()["attributed"]
+    assert row["lane"] == "unlabeled" and row["kernel"] == "bisect"
+
+
+def test_overlap_hint_accrues_overlap_seconds():
+    """The dispatcher's double-buffer hint marks the whole dispatch as
+    pipelined against other work — the on-device measure of the
+    continuous-batching win."""
+    clock = FakeClock()
+    led = DeviceLedger(clock=clock)
+    with led.lane_flush("attestation", overlapped=True):
+        with led.dispatch("grouped", (0,)):
+            clock.advance(0.4)
+    (row,) = led.snapshot()["attributed"]
+    assert row["overlap_s"] == pytest.approx(0.4)
+    # idle wall accrues once work stops
+    clock.advance(0.6)
+    snap = led.snapshot()
+    assert snap["idle_wall_s"] == pytest.approx(snap["uptime_s"] - 0.4)
+    assert 0.0 < snap["utilization"] < 1.0
+
+
+def test_pipeline_fanout_exports_device_families():
+    p = PipelineMetrics()
+    clock = FakeClock()
+    led = DeviceLedger(clock=clock)
+    led.attach(p)
+    with led.lane_flush("block", overlapped=True):
+        clock.advance(0.2)
+    led.snapshot()
+    assert p.device_dispatch_seconds.value(
+        lane="block", kernel="lane_flush", chip="0"
+    ) == pytest.approx(0.2)
+    assert p.device_overlap_seconds.value(
+        lane="block", kernel="lane_flush", chip="0"
+    ) == pytest.approx(0.2)
+    assert p.device_idle_wall.value() >= 0.0
+    text = p.registry.expose()
+    assert "lodestar_tpu_device_dispatch_seconds_total" in text
+
+
+def test_real_dispatcher_attributes_95_percent_of_device_wall_time():
+    """ISSUE 16 acceptance: drive the REAL BlsLaneDispatcher with a
+    sleeping stub verifier — the ledger must attribute >= 95% of the
+    wall-clock device time the flushes actually held."""
+
+    class SleepVerifier(MockBlsVerifier):
+        def verify_signature_sets(self, sets):
+            time.sleep(0.03)
+            return super().verify_signature_sets(sets)
+
+    p = PipelineMetrics()
+    d = BlsLaneDispatcher(
+        SleepVerifier(), max_sigs=32, max_wait_ms=10_000, workers=1,
+        pending_cap=0, lane_caps={}, waiter_timeout_s=60.0, pipeline=p,
+    )
+    try:
+        for i in range(4):
+            assert d.verify_signature_sets([f"s{i}"], lane="block") is True
+    finally:
+        d.close()
+    snap = device_ledger.ledger().snapshot()
+    assert snap["dispatches"] >= 4
+    assert snap["busy_wall_s"] >= 4 * 0.03 * 0.9
+    assert snap["attributed_busy_s"] >= 0.95 * snap["busy_wall_s"]
+    lanes = {r["lane"] for r in snap["attributed"]}
+    assert lanes == {"block"}
+
+
+# --- memory sampler -----------------------------------------------------------
+
+
+def test_memory_watermark_is_monotonic_and_mem_is_live():
+    reads = [
+        {"0": {"in_use": 100, "peak": 120, "limit": 1000}},
+        {"0": {"in_use": 400, "peak": 400, "limit": 1000}},
+        {"0": {"in_use": 50, "peak": 400, "limit": 1000}},
+    ]
+    p = PipelineMetrics()
+    led = DeviceLedger(memory_stats_fn=lambda: reads.pop(0))
+    led.attach(p)
+    for _ in range(3):
+        led.sample_memory(force=True)
+    snap = led.snapshot()  # 4th snapshot-sample would pop an empty list,
+    assert snap["memory_samples"] == 3  # but the rate limiter holds it
+    mem = snap["memory"]["0"]
+    assert mem["in_use"] == 50  # live value follows the sampler down
+    assert mem["watermark_bytes"] == 400  # watermark never does
+    assert p.device_memory.value(chip="0", kind="in_use") == 50
+    assert p.device_memory_watermark.value(chip="0") == 400
+    # the rises were flight-recorded for the post-mortem
+    from lodestar_tpu.observability import flight_recorder
+    marks = [e for e in flight_recorder.recorder().dump()["events"]
+             if e["kind"] == "device_mem_watermark"]
+    assert [m["bytes"] for m in marks[-2:]] == [100, 400]
+
+
+def test_memory_sampler_disabled_and_erroring_fn_is_contained(monkeypatch):
+    monkeypatch.setenv("LODESTAR_TPU_DEVICE_LEDGER_MEM_SAMPLE_S", "0")
+    led = DeviceLedger(memory_stats_fn=lambda: {"0": {"in_use": 9}})
+    led.sample_memory()
+    assert led.snapshot()["memory_samples"] == 0  # 0 disables
+    led.sample_memory(force=True)  # force bypasses the off switch
+    assert led.snapshot()["memory"]["0"]["in_use"] == 9
+
+    def boom():
+        raise RuntimeError("no allocator stats")
+
+    led2 = DeviceLedger(memory_stats_fn=boom)
+    led2.sample_memory(force=True)  # must not raise into the caller
+    assert led2.snapshot()["memory_samples"] == 0  # a failed read is no sample
+    from lodestar_tpu.observability import flight_recorder
+    kinds = [e["kind"] for e in flight_recorder.recorder().dump()["events"]]
+    assert "device_mem_sample_error" in kinds
+
+
+# --- endpoint + post-mortem ---------------------------------------------------
+
+
+def test_debug_device_endpoint_serves_singleton_snapshot():
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+
+    clock = FakeClock()
+    with device_ledger.ledger().dispatch("grouped", (0,)):
+        time.sleep(0.01)
+    server = MetricsServer(MetricsRegistry(), port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/device"
+        with urllib.request.urlopen(url) as r:
+            doc = json.load(r)
+        assert doc["wired"] is True
+        assert doc["dispatches"] == 1
+        assert doc["attributed"][0]["kernel"] == "grouped"
+    finally:
+        server.close()
+
+    server = MetricsServer(MetricsRegistry(), port=0, device=lambda: None)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/device"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": False}
+    finally:
+        server.close()
+
+
+def test_watchdog_rc124_emission_carries_device_section():
+    """ISSUE 16 acceptance: a timed-out bench round's post-mortem names
+    what was on the device — the watchdog document must embed the ledger
+    snapshot (sections are read at emit time)."""
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO_ROOT!r})\n"
+        "from lodestar_tpu.observability.bench_emit import BenchEmitter\n"
+        "from lodestar_tpu.observability import device_ledger\n"
+        "led = device_ledger.ledger()\n"
+        "em = BenchEmitter('m', 'sets/s', global_deadline_s=0.3)\n"
+        "em.add_section('device', led.snapshot)\n"
+        "with led.lane_flush('block'):\n"
+        "    time.sleep(0.02)\n"
+        "with em.phase('stuck'):\n"
+        "    time.sleep(30)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out, _ = proc.communicate(timeout=20)
+    assert proc.returncode == 124
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert doc["timed_out"] is True
+    device = doc["device"]
+    assert device["dispatches"] == 1
+    assert device["attributed"][0]["lane"] == "block"
+    assert device["busy_wall_s"] > 0.0

@@ -307,3 +307,17 @@ def test_validation_lane_hint_capability_detection():
     with pytest.raises(TypeError):
         # a TypeError raised INSIDE verification propagates untouched
         _verify_lane(_Legacy(), [], "attestation")
+
+
+def test_overlap_fraction_gauge_exported_before_first_flood():
+    """ISSUE 16 satellite: `lodestar_bls_lane_overlap_fraction` must be a
+    live series from dispatcher construction — before the first flood,
+    /debug/lanes and /metrics showed no overlap series at all, so a
+    dashboard couldn't tell "no overlap yet" from "not wired"."""
+    p = PipelineMetrics()
+    d = _dispatcher(pipeline=p)
+    try:
+        assert p.lane_overlap_fraction.value() == 0.0
+        assert "lodestar_bls_lane_overlap_fraction 0" in p.registry.expose()
+    finally:
+        d.close()

@@ -469,3 +469,40 @@ def test_check_dashboards_flags_unknown_metric(tmp_path, capsys):
     (tmp_path / "bad.json").write_text(json.dumps(bad))
     assert mod.main(["check", str(tmp_path)]) == 1
     assert "lodestar_totally_made_up_total" in capsys.readouterr().out
+
+
+def test_check_dashboards_flags_planted_slo_rules_violation(tmp_path, capsys):
+    """ISSUE 16 satellite: the slo_rules lint catches an objective whose
+    source metric no registry family declares, and a file under the
+    committed-objectives floor (planted fixture)."""
+    import importlib.util
+    import shutil
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "check_dashboards.py",
+    )
+    spec = importlib.util.spec_from_file_location("check_dashboards3", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    dash_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dashboards",
+    )
+    # real dashboards + the planted-violation rules file: only the rules
+    # lint fires
+    for name in os.listdir(dash_dir):
+        if name != mod.SLO_RULES_FILE:
+            shutil.copy(os.path.join(dash_dir, name), tmp_path / name)
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "lint_fixtures", "slo_rules_bad.json",
+    )
+    shutil.copy(fixture, tmp_path / mod.SLO_RULES_FILE)
+    assert mod.main(["check", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "SLO-RULES" in out
+    assert "phantom_latency" in out
+    assert "lodestar_bls_totally_made_up_seconds" in out
+    assert "commits only 2 objectives" in out
+    assert "SLO rules problem" in out

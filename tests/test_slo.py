@@ -1,0 +1,347 @@
+"""SLO engine tests (ISSUE 16 tentpole A): budget math per SLI kind,
+multi-window burn transitions on scripted histories with a fake clock,
+committed-rules round-trip, the /debug/slo endpoint, and the acceptance
+case — an injected fault (testing/faults.py) flips an objective from ok
+to burning.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from lodestar_tpu.chain.bls_verifier import MockBlsVerifier
+from lodestar_tpu.chain.supervisor import SupervisedBlsVerifier
+from lodestar_tpu.observability import slo
+from lodestar_tpu.observability.slo import SloEngine, load_rules, validate_rules
+from lodestar_tpu.observability.stages import PipelineMetrics
+from lodestar_tpu.testing import faults
+
+WINDOWS = {"short_s": 300.0, "long_s": 3600.0}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    slo._reset_for_tests()
+    faults.clear(reset_counters=True)
+    yield
+    slo._reset_for_tests()
+    faults.clear(reset_counters=True)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _rules(*objectives):
+    return {"windows": dict(WINDOWS), "objectives": list(objectives)}
+
+
+# --- rules file round-trip ----------------------------------------------------
+
+
+def test_committed_rules_load_and_evaluate_clean():
+    """The committed dashboards/slo_rules.json parses, commits >= 6
+    objectives, and every source family resolves against a live
+    PipelineMetrics — a fresh node starts with zero objectives burning
+    (and zero `absent`: a committed objective over a family this
+    registry can't see would never be judged)."""
+    rules = load_rules()
+    assert len(rules["objectives"]) >= 6
+    eng = SloEngine(PipelineMetrics(), rules=rules)
+    reports = eng.evaluate()
+    assert len(reports) == len(rules["objectives"])
+    assert all(r["state"] == "ok" for r in reports)
+    assert all(r["runbook"].startswith("docs/observability.md#")
+               for r in reports)
+
+
+def test_validate_rules_rejects_malformed_documents():
+    with pytest.raises(ValueError, match="windows"):
+        validate_rules({"objectives": [{"name": "x"}]})
+    with pytest.raises(ValueError, match="short_s must be <"):
+        validate_rules({"windows": {"short_s": 10, "long_s": 10},
+                        "objectives": [{}]})
+    base = {"windows": dict(WINDOWS)}
+    with pytest.raises(ValueError, match="no objectives"):
+        validate_rules({**base, "objectives": []})
+    with pytest.raises(ValueError, match="unknown kind"):
+        validate_rules(_rules(
+            {"name": "x", "source": "m", "kind": "percentile_over"}
+        ))
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_rules(_rules(
+            {"name": "x", "source": "m", "kind": "counter_zero"},
+            {"name": "x", "source": "m", "kind": "counter_zero"},
+        ))
+    with pytest.raises(ValueError, match="threshold"):
+        validate_rules(_rules(
+            {"name": "x", "source": "m", "kind": "gauge_under"}
+        ))
+    with pytest.raises(ValueError, match="good_label"):
+        validate_rules(_rules(
+            {"name": "x", "source": "m", "kind": "label_ratio"}
+        ))
+
+
+# --- SLI kinds / budget math --------------------------------------------------
+
+
+def test_counter_zero_burns_on_labeled_bad_event():
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "zero_block_sheds",
+        "source": "lodestar_bls_lane_shed_total",
+        "kind": "counter_zero",
+        "labels": {"lane": "block"},
+    }), clock=clock)
+    clock.advance(1.0)
+    # a shed on a DIFFERENT lane is outside the label subset: still ok
+    p.lane_shed("attestation", 5)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "ok" and rep["bad_events"] == 0
+    clock.advance(1.0)
+    p.lane_shed("block", 2)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "burning"
+    assert rep["bad_events"] == 2
+    assert rep["budget_remaining"] == 0.0
+
+
+def test_histogram_under_budget_math():
+    """good = observations <= threshold: 9 fast + 1 slow flush against a
+    target of 0.95 leaves a 10% bad fraction over a 5% budget — burn
+    rate 2.0 in both windows."""
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "flush_latency",
+        "source": "lodestar_bls_verifier_flush_seconds",
+        "kind": "histogram_under",
+        "threshold": 0.5,
+        "target": 0.95,
+    }), clock=clock)
+    clock.advance(1.0)
+    for _ in range(9):
+        p.flush("size", latency_s=0.01)
+    p.flush("size", latency_s=2.0)  # over the 0.5 s threshold
+    (rep,) = eng.evaluate()
+    assert rep["total_events"] == 10 and rep["bad_events"] == 1
+    assert rep["burn_rate_short"] == pytest.approx(2.0)
+    assert rep["burn_rate_long"] == pytest.approx(2.0)
+    assert rep["state"] == "burning"
+    assert rep["budget_remaining"] == 0.0
+    # 90 more fast flushes dilute the bad fraction to 1% < 5% budget
+    clock.advance(1.0)
+    for _ in range(90):
+        p.flush("size", latency_s=0.01)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "ok"
+    assert rep["budget_remaining"] == pytest.approx(0.8)
+
+
+def test_label_ratio_compile_cache_hit_rate():
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "compile_cache_hit_rate",
+        "source": "lodestar_tpu_compile_events_total",
+        "kind": "label_ratio",
+        "good_label": {"cache": "hit"},
+        "bad_label": {"cache": "miss"},
+        "target": 0.9,
+    }), clock=clock)
+    clock.advance(1.0)
+    for _ in range(19):
+        p.compile_event("verify_grouped", "hit", 0.001)
+    p.compile_event("verify_grouped", "miss", 4.0)
+    (rep,) = eng.evaluate()
+    assert rep["total_events"] == 20 and rep["bad_events"] == 1
+    assert rep["state"] == "ok"  # 5% miss rate inside the 10% budget
+    clock.advance(1.0)
+    for _ in range(5):
+        p.compile_event("verify_bisect", "miss", 4.0)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "burning"  # 6/25 = 24% miss vs 10% budget
+
+
+def test_gauge_under_unset_gauge_contributes_no_sample():
+    """A node that never reported serving-ready can't burn the cold-start
+    objective; once the gauge reads over threshold, every evaluation is a
+    bad sample."""
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "serving_ready",
+        "source": "lodestar_tpu_serving_ready_seconds",
+        "kind": "gauge_under",
+        "threshold": 10.0,
+        "target": 1.0,
+    }), clock=clock)
+    clock.advance(1.0)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "ok" and rep["total_events"] == 0
+    p.serving_ready(22.5)  # blew the 10 s cold-start SLO
+    clock.advance(1.0)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "burning" and rep["bad_events"] == 1
+
+
+def test_absent_source_reports_absent_not_crash():
+    p = PipelineMetrics()
+    eng = SloEngine(p, rules=_rules({
+        "name": "phantom",
+        "source": "lodestar_not_a_family_total",
+        "kind": "counter_zero",
+    }))
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "absent"
+    assert rep["budget_remaining"] == 1.0
+
+
+# --- multi-window burn transitions -------------------------------------------
+
+
+def test_burn_clears_when_short_window_goes_quiet():
+    """Multi-window semantics on a scripted history: a bad burst burns
+    (young engine: both windows see it), then once the burst ages past
+    the SHORT window the objective recovers even though the long window
+    still remembers it — and the recovery is a recorded transition."""
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "zero_sheds",
+        "source": "lodestar_bls_lane_shed_total",
+        "kind": "counter_zero",
+    }), clock=clock)
+    clock.advance(1.0)
+    p.lane_shed("attestation", 1)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "burning"
+    # quiet evaluations inside the short window: still burning (the bad
+    # event is in BOTH trailing windows)
+    clock.advance(60.0)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "burning"
+    # age the burst past the 300 s short window: short goes quiet -> ok
+    clock.advance(WINDOWS["short_s"] + 60.0)
+    (rep,) = eng.evaluate()
+    assert rep["state"] == "ok"
+    assert rep["burn_rate_long"] > 0.0  # long window still remembers
+    from lodestar_tpu.observability import flight_recorder
+    kinds = [e for e in flight_recorder.recorder().dump()["events"]
+             if e["kind"] == "slo_transition"]
+    states = [e["state"] for e in kinds if e["objective"] == "zero_sheds"]
+    assert states[-2:] == ["burning", "ok"]
+
+
+def test_slo_families_exported_on_pipeline():
+    p = PipelineMetrics()
+    clock = FakeClock()
+    eng = SloEngine(p, rules=_rules({
+        "name": "zero_sheds",
+        "source": "lodestar_bls_lane_shed_total",
+        "kind": "counter_zero",
+    }), clock=clock)
+    clock.advance(1.0)
+    p.lane_shed("block", 1)
+    eng.evaluate()
+    assert p.slo_burning.value(objective="zero_sheds") == 1
+    assert p.slo_budget_remaining.value(objective="zero_sheds") == 0.0
+    assert p.slo_burn_rate.value(objective="zero_sheds", window="short") > 0
+    assert p.slo_evaluations.value() >= 2  # baseline + explicit
+    text = p.registry.expose()
+    assert "lodestar_slo_burning" in text
+
+
+# --- singleton / poke / endpoint ---------------------------------------------
+
+
+def test_install_engine_snapshot_and_poke_rate_limit(monkeypatch):
+    monkeypatch.setenv("LODESTAR_TPU_SLO_POKE_S", "3600")
+    assert slo.snapshot_or_none() is None  # nothing installed yet
+    p = PipelineMetrics()
+    eng = slo.install(p)
+    assert slo.engine() is eng
+    snap = slo.snapshot_or_none()
+    assert snap["rules_path"].endswith("slo_rules.json")
+    assert snap["burning"] == []
+    assert {o["name"] for o in snap["objectives"]} == set(eng.objectives())
+    before = snap["evaluations"]
+    slo.poke()  # first poke evaluates
+    slo.poke()  # rate-limited: swallowed
+    with eng._lock:
+        evals = eng._evaluations
+    assert evals == before + 1
+
+
+def test_debug_slo_endpoint_serves_engine_snapshot():
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+
+    server = MetricsServer(MetricsRegistry(), port=0)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/slo"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": False}  # no engine installed
+        p = PipelineMetrics()
+        slo.install(p)
+        p.lane_shed("block", 1)
+        with urllib.request.urlopen(url) as r:
+            doc = json.load(r)
+        assert doc["wired"] is True
+        assert "zero_block_sheds" in doc["burning"]
+        by_name = {o["name"]: o for o in doc["objectives"]}
+        assert by_name["zero_block_sheds"]["state"] == "burning"
+        assert by_name["zero_block_sheds"]["runbook"]
+    finally:
+        server.close()
+
+
+# --- ISSUE 16 acceptance: injected fault flips an objective ------------------
+
+
+class _FaultyDevice:
+    """Device verifier that routes through the testing/faults seam, like
+    TpuBlsVerifier does on every dispatch."""
+
+    observer = None
+
+    def verify_signature_sets(self, sets):
+        faults.on_device_dispatch(len(sets))
+        return True
+
+    def verify_signature_sets_individual(self, sets):
+        faults.on_device_dispatch(len(sets))
+        return [True] * len(sets)
+
+
+def test_injected_fault_flips_breaker_objective_to_burning():
+    """testing/faults exception mode opens the supervisor breaker; the
+    committed `breaker_closed` objective must go ok -> burning on the
+    next evaluation (the alert an operator would page on)."""
+    p = PipelineMetrics()
+    sup = SupervisedBlsVerifier(
+        _FaultyDevice(), MockBlsVerifier(), observer=p,
+        deadline_s=5.0, failure_threshold=2, retries=0,
+        retry_base_delay_s=0.001, canary_thread=False,
+        canary_sets=[object()],
+    )
+    eng = slo.install(p)
+    by_name = {r["name"]: r for r in eng.evaluate()}
+    assert by_name["breaker_closed"]["state"] == "ok"
+    faults.configure("exception")
+    sup.verify_signature_sets([object()])
+    sup.verify_signature_sets([object()])
+    assert p.supervisor_breaker_state.value() == 2  # breaker open
+    by_name = {r["name"]: r for r in eng.evaluate()}
+    assert by_name["breaker_closed"]["state"] == "burning"
+    assert p.slo_burning.value(objective="breaker_closed") == 1

@@ -34,6 +34,16 @@ Comparison rules:
   silently drop it out of the gate; a required key present in the prior
   round but MISSING from the current one fails the run (a disappeared
   row hides regressions as effectively as a slow one);
+- every round's SLO section (the bench document's `slo` verdicts from
+  observability/slo.py, round 16) is compared objective-by-objective:
+  the report prints each objective's prev->curr state delta, and a
+  CURRENT round with a `burning` objective fails the gate WITH THE
+  OBJECTIVE'S NAME — an error budget burning is a regression even when
+  every raw number sits inside the 3x band. Rounds predating the SLO
+  engine report `n/a` and never gate. Degraded/timed-out rounds are
+  still skipped from numeric comparison, but their burn state is
+  REPORTED (the skip note carries which objectives were burning when
+  the round died). `--slo-only` gates exclusively on SLO verdicts;
 - fewer than two parseable rounds exits 0 with a note (nothing to gate
   against), never a false red;
 - each round's cumulative XLA compile seconds (the bench document's
@@ -121,6 +131,45 @@ def _compile_seconds(doc) -> float | None:
     return None
 
 
+def _slo_state(doc) -> dict | None:
+    """{objective: state} + the burning list from the bench document's
+    `slo` section (observability/slo.py), or None for rounds predating
+    the SLO engine."""
+    section = doc.get("slo") if isinstance(doc, dict) else None
+    if not isinstance(section, dict):
+        return None
+    objectives = {
+        o["name"]: o.get("state", "?")
+        for o in section.get("objectives", ())
+        if isinstance(o, dict) and o.get("name")
+    }
+    if not objectives:
+        return None
+    return {
+        "objectives": objectives,
+        "burning": sorted(k for k, v in objectives.items() if v == "burning"),
+    }
+
+
+def _print_burn_state(n: int, slo: dict | None) -> None:
+    """One-line burn-state report for a round skipped from numeric
+    comparison (degraded/timed-out): the skip must still say what the
+    objectives looked like when the round died."""
+    if slo is None:
+        print(f"bench_compare: r{n:02d} burn state — n/a (round predates "
+              "the SLO engine)")
+    elif slo["burning"]:
+        print(
+            f"bench_compare: r{n:02d} burn state — BURNING: "
+            f"{', '.join(slo['burning'])}"
+        )
+    else:
+        print(
+            f"bench_compare: r{n:02d} burn state — all "
+            f"{len(slo['objectives'])} objectives ok"
+        )
+
+
 def _is_degraded(doc) -> bool:
     """A bench document that ran with CPU fallbacks / open breaker /
     armed faults labels itself via the emitter's `supervisor` section."""
@@ -177,6 +226,7 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
                 "DEGRADED (CPU fallback / open breaker / faults armed); "
                 "not comparable to device-path rounds"
             )
+            _print_burn_state(int(m.group(1)), _slo_state(parsed))
             continue
         if parsed.get("timed_out"):
             # the watchdog/SIGTERM flushed a PARTIAL document before the
@@ -187,6 +237,7 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
                 "out mid-run (partial watchdog flush); rates not "
                 "comparable to completed rounds"
             )
+            _print_burn_state(int(m.group(1)), _slo_state(parsed))
             continue
         rows = _numeric_rows(parsed)
         if rows:
@@ -194,6 +245,7 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
                 "n": int(m.group(1)),
                 "rows": rows,
                 "compile_s": _compile_seconds(parsed),
+                "slo": _slo_state(parsed),
             })
     rounds.sort(key=lambda r: r["n"])
     if rounds and details_path and os.path.exists(details_path):
@@ -213,7 +265,29 @@ def load_history(root_dir: str, details_path: str | None = None) -> list[dict]:
             rounds[-1]["rows"].setdefault(key, value)
         if rounds[-1].get("compile_s") is None and detail_rows:
             rounds[-1]["compile_s"] = _compile_seconds(detail_doc)
+        if rounds[-1].get("slo") is None and detail_rows:
+            rounds[-1]["slo"] = _slo_state(detail_doc)
     return rounds
+
+
+def compare_slo(prev: dict, curr: dict) -> tuple[list, list]:
+    """(report_rows, regressions) for the SLO verdicts: every objective
+    seen in either round gets a prev->curr state line, and an objective
+    BURNING in the current round is a named regression — the whole point
+    of the engine is that a burnt budget fails the gate by name."""
+    prev_slo = prev.get("slo")
+    curr_slo = curr.get("slo")
+    report, regressions = [], []
+    prev_obj = prev_slo["objectives"] if prev_slo else {}
+    curr_obj = curr_slo["objectives"] if curr_slo else {}
+    for name in sorted(set(prev_obj) | set(curr_obj)):
+        p = prev_obj.get(name, "n/a")
+        c = curr_obj.get(name, "n/a")
+        burning_now = c == "burning"
+        report.append((name, p, c, burning_now))
+        if burning_now:
+            regressions.append(f"slo:{name} (error budget burning)")
+    return report, regressions
 
 
 def _direction(key: str) -> str | None:
@@ -292,6 +366,9 @@ def main(argv=None) -> int:
     ap.add_argument("--details", default=None,
                     help="bench_details.json for the latest round "
                          "(default: <dir>/bench_details.json)")
+    ap.add_argument("--slo-only", action="store_true",
+                    help="gate exclusively on SLO verdicts (skip the "
+                         "numeric threshold comparison)")
     args = ap.parse_args(argv)
 
     details = args.details or os.path.join(args.dir, "bench_details.json")
@@ -310,37 +387,67 @@ def main(argv=None) -> int:
             )
         return 0
     prev, curr = history[-2], history[-1]
-    report, regressions = compare(prev, curr, args.threshold)
-    print(
-        f"bench_compare: r{prev['n']:02d} -> r{curr['n']:02d} "
-        f"({len(report)} gated keys, threshold {args.threshold}x)"
-    )
-    for key, direction, p, c, ratio, regressed in report:
-        tag = "REGRESSION" if regressed else "ok"
-        arrow = "^" if direction == "up" else "v"
+    slo_report, slo_regressions = compare_slo(prev, curr)
+    regressions = []
+    if args.slo_only:
         print(
-            f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
-            f"(worse x{ratio:.2f})" if ratio > 1.0 else
-            f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
-            f"(better x{1 / ratio:.2f})"
+            f"bench_compare: r{prev['n']:02d} -> r{curr['n']:02d} "
+            "(--slo-only: numeric thresholds skipped)"
         )
-    pc, cc = prev.get("compile_s"), curr.get("compile_s")
-    if pc is not None or cc is not None:
-        def _fmt(v):
-            return f"{v:.1f}s" if v is not None else "n/a"
+    else:
+        report, regressions = compare(prev, curr, args.threshold)
+        print(
+            f"bench_compare: r{prev['n']:02d} -> r{curr['n']:02d} "
+            f"({len(report)} gated keys, threshold {args.threshold}x)"
+        )
+        for key, direction, p, c, ratio, regressed in report:
+            tag = "REGRESSION" if regressed else "ok"
+            arrow = "^" if direction == "up" else "v"
+            print(
+                f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
+                f"(worse x{ratio:.2f})" if ratio > 1.0 else
+                f"  {tag:>10}  {key} [{arrow}]  {p:.2f} -> {c:.2f}  "
+                f"(better x{1 / ratio:.2f})"
+            )
+        pc, cc = prev.get("compile_s"), curr.get("compile_s")
+        if pc is not None or cc is not None:
+            def _fmt(v):
+                return f"{v:.1f}s" if v is not None else "n/a"
 
+            print(
+                f"  info        cumulative compile seconds {_fmt(pc)} -> "
+                f"{_fmt(cc)} (informational; not gated — varies with cache "
+                "warmth, see compile_ledger)"
+            )
+    if slo_report:
+        print(f"  slo verdicts r{prev['n']:02d} -> r{curr['n']:02d}:")
+        for name, p, c, burning_now in slo_report:
+            tag = "BURNING" if burning_now else "ok"
+            print(f"  {tag:>10}  slo:{name}  {p} -> {c}")
+    else:
         print(
-            f"  info        cumulative compile seconds {_fmt(pc)} -> "
-            f"{_fmt(cc)} (informational; not gated — varies with cache "
-            "warmth, see compile_ledger)"
+            "  info        no SLO verdicts in either round (rounds predate "
+            "the SLO engine; not gated)"
         )
+    failed = False
     if regressions:
         print(
             f"FAIL: {len(regressions)} key(s) regressed more than "
             f"{args.threshold}x: {', '.join(regressions)}"
         )
+        failed = True
+    if slo_regressions:
+        print(
+            f"FAIL: {len(slo_regressions)} SLO objective(s) burning their "
+            f"error budget: {', '.join(slo_regressions)}"
+        )
+        failed = True
+    if failed:
         return 1
-    print("OK: no gated key regressed past the threshold")
+    if args.slo_only:
+        print("OK: no SLO objective is burning its error budget")
+    else:
+        print("OK: no gated key regressed past the threshold")
     return 0
 
 

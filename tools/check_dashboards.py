@@ -81,8 +81,25 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_tpu_build_info",
         "lodestar_tpu_serving_ready_seconds",
         "lodestar_tpu_startup_phase_seconds",
+        # SLO engine families (ISSUE 16): every lodestar_slo_* family
+        # must be on the fleet summary — burn state nobody can see is
+        # not an alerting layer
+        "lodestar_slo_burning",
+        "lodestar_slo_budget_remaining_fraction",
+        "lodestar_slo_burn_rate",
+        "lodestar_slo_evaluations_total",
+        # device-time & memory ledger families (ISSUE 16): where
+        # device-seconds and HBM bytes go, per lane x kernel x chip
+        "lodestar_tpu_device_dispatch_seconds_total",
+        "lodestar_tpu_device_overlap_seconds_total",
+        "lodestar_tpu_device_idle_wall_seconds",
+        "lodestar_tpu_device_memory_bytes",
+        "lodestar_tpu_device_memory_watermark_bytes",
     ),
 }
+
+SLO_RULES_FILE = "slo_rules.json"
+SLO_RULES_MIN_OBJECTIVES = 6
 
 # 16/16 parity with the reference dashboard set (ISSUE 2): one file per
 # reference dashboard, mapped to this repo's subsystem names
@@ -157,6 +174,42 @@ def dashboard_refs(dash_dir: str):
                     yield os.path.basename(path), panel.get("title", "?"), name
 
 
+def lint_slo_rules(dash_dir: str, families: set[str]) -> list[str]:
+    """Lint `dashboards/slo_rules.json` (ISSUE 16): the file must parse,
+    satisfy the engine's schema, commit at least SLO_RULES_MIN_OBJECTIVES
+    objectives, and every objective's source metric must exist in the
+    registry — a typo'd source silently never burns."""
+    sys.path.insert(0, REPO_ROOT)
+    from lodestar_tpu.observability.slo import validate_rules
+
+    path = os.path.join(dash_dir, SLO_RULES_FILE)
+    if not os.path.exists(path):
+        return [f"{SLO_RULES_FILE} absent from {dash_dir} (the SLO engine "
+                "has no committed objectives)"]
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError) as e:
+        return [f"{SLO_RULES_FILE} unreadable: {e}"]
+    try:
+        validate_rules(doc)
+    except ValueError as e:
+        return [f"{SLO_RULES_FILE} schema: {e}"]
+    problems = []
+    objectives = doc["objectives"]
+    if len(objectives) < SLO_RULES_MIN_OBJECTIVES:
+        problems.append(
+            f"{SLO_RULES_FILE} commits only {len(objectives)} objectives "
+            f"(>= {SLO_RULES_MIN_OBJECTIVES} required)"
+        )
+    for obj in objectives:
+        if obj["source"] not in families:
+            problems.append(
+                f"objective {obj['name']!r} reads source metric "
+                f"{obj['source']!r} which no registry family declares"
+            )
+    return problems
+
+
 def main(argv=None) -> int:
     dash_dir = os.path.join(REPO_ROOT, "dashboards")
     if argv and len(argv) > 1:
@@ -196,6 +249,9 @@ def main(argv=None) -> int:
                 unplotted_required.append((fname, name))
     for fname, name in unplotted_required:
         print(f"NO-PANEL {name}  (required on {fname})")
+    slo_problems = lint_slo_rules(dash_dir, families)
+    for problem in slo_problems:
+        print(f"SLO-RULES {problem}")
     unexported = sorted(families - referenced_families)
     if unexported:
         print(
@@ -204,7 +260,7 @@ def main(argv=None) -> int:
         )
         for name in unexported:
             print(f"  unplotted {name}")
-    if missing or absent or unplotted_required:
+    if missing or absent or unplotted_required or slo_problems:
         if missing:
             print(
                 f"FAIL: {len(missing)} dashboard references missing from "
@@ -220,11 +276,17 @@ def main(argv=None) -> int:
                 f"FAIL: {len(unplotted_required)} required panel metric(s) "
                 "not plotted by their dashboard"
             )
+        if slo_problems:
+            print(
+                f"FAIL: {len(slo_problems)} SLO rules problem(s) in "
+                f"{SLO_RULES_FILE}"
+            )
         return 1
     print(
         f"OK: {len(REQUIRED_DASHBOARDS)}/16 dashboards present, every "
         f"dashboard metric resolves "
-        f"({len(referenced_families)}/{len(families)} families plotted)"
+        f"({len(referenced_families)}/{len(families)} families plotted), "
+        "slo_rules.json clean"
     )
     return 0
 

@@ -76,6 +76,14 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
 
     enable_compile_cache(CACHE_DIR)
     timeline().mark("warmup_start")
+    # objectives loaded before any kernel warms: the cold-start table
+    # gains an `slo_ready` column, and burn state covers the whole
+    # warmup ladder (a wedged compile shows as serving_ready burning)
+    from lodestar_tpu.observability import slo
+    from lodestar_tpu.observability.stages import default_pipeline
+
+    slo.install(default_pipeline())
+    timeline().mark("slo_ready")
     import jax
 
     from __graft_entry__ import (
