@@ -172,6 +172,17 @@ class DeviceBlsVerifier:
     def mesh_snapshot(self):
         return self._inner.mesh_snapshot()
 
+    # -- epoch-resident crypto passthroughs (ISSUE 18) ----------------------
+
+    def warm_h2c(self, messages) -> int:
+        return self._inner.warm_h2c(messages)
+
+    def epoch_table_populate(self, epoch: int, pubkeys) -> int:
+        return self._inner.epoch_table_populate(epoch, pubkeys)
+
+    def epoch_table_snapshot(self):
+        return self._inner.epoch_table_snapshot()
+
     def _note_decompress_fallback(self, sets) -> None:
         """Count + rate-limited-log a device-decompress batch downgraded
         to host marshal because `_native_eligible` rejected its shape —

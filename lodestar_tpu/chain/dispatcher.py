@@ -82,7 +82,7 @@ class BlsLaneDispatcher(ThreadBufferedVerifier):
                  pending_cap: int | None = None,
                  lane_caps: dict[str, int] | None = None):
         from .bls_verifier import MAX_BUFFER_WAIT_MS, MAX_BUFFERED_SIGS
-        from ..utils.env import env_int
+        from ..utils.env import env_bool, env_int
 
         super().__init__(
             verifier,
@@ -100,6 +100,9 @@ class BlsLaneDispatcher(ThreadBufferedVerifier):
             if pending_cap is None else pending_cap
         )
         self.lane_caps = _lane_caps_from_env() if lane_caps is None else dict(lane_caps)
+        # H(msg) dedup at the coalescing point (ISSUE 18): a flood of
+        # aggregates for one attestation pays one hash_to_g2
+        self._h2c_dedup = env_bool("LODESTAR_TPU_H2C_DEDUP")
         # the Condition shares self._lock (created by the base __init__),
         # so waiters/notifies and the guarded-by annotations agree
         self._cv = threading.Condition(self._lock)
@@ -347,6 +350,7 @@ class BlsLaneDispatcher(ThreadBufferedVerifier):
                 self.prom.bls_buffer_wait_seconds.observe(now - enq)
         self.pipeline.lane_coalesce(n_sets)
         self.pipeline.lane_overlap(overlapped)
+        self._dedup_h2c(entries)
         t0 = time.monotonic()
         try:
             # device-time attribution: entries drain in strict priority
@@ -370,6 +374,44 @@ class BlsLaneDispatcher(ThreadBufferedVerifier):
         for (_, ev, holder, _, _), verdict in zip(entries, per_request):
             holder[0] = verdict
             ev.set()
+
+    def _dedup_h2c(self, entries) -> None:
+        """H(msg) dedup across the coalesced batch (ISSUE 18): committee
+        traffic repeats attestation data across aggregates, so hash each
+        UNIQUE 32-byte root once through the verifier's h2c cache before
+        the marshal path walks the sets. Purely a pre-warm — the marshal
+        path then hits `_h2c_cache` for every duplicate, so verdicts are
+        bit-identical with dedup on or off. Verifiers without `warm_h2c`
+        (mock/CPU tiers) skip silently."""
+        if not self._h2c_dedup:
+            return
+        warm = getattr(self.verifier, "warm_h2c", None)
+        if warm is None:
+            return
+        seen: set = set()
+        dupes = 0
+        for sets, _, _, _, _ in entries:
+            for s in sets:
+                try:
+                    m = bytes(s.message)
+                except (AttributeError, TypeError, ValueError):
+                    continue  # mock/opaque sets have no message shape
+                if len(m) != 32:
+                    continue
+                if m in seen:
+                    dupes += 1
+                else:
+                    seen.add(m)
+        if not seen:
+            return
+        try:
+            warm(seen)
+        except Exception:
+            from ..utils.logger import get_logger
+
+            get_logger("bls-verifier").exception("h2c dedup pre-warm failed")
+            return
+        self.pipeline.h2c_dedup(dupes)
 
     # -- lifecycle ----------------------------------------------------------
 

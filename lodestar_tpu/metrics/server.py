@@ -36,6 +36,11 @@ profiler + lifecycle-trace control surface:
                           device-seconds by lane x kernel x chip plus
                           the sampled per-chip memory watermarks
                           (observability/device_ledger.py)
+    GET /debug/epoch_table  epoch-resident pubkey table census: rows and
+                          device residency per retained epoch, eviction
+                          and device-put-failure counters
+                          (parallel/epoch_table.py); nodes without the
+                          table (CPU tier, knob off) report wired: false
 
 (GET also accepted on the profiler routes — operator curl ergonomics.)
 The profiler hooks default to `observability.trace`, the same process-
@@ -67,6 +72,7 @@ class MetricsServer:
         lanes=None,
         slo=None,
         device=None,
+        epoch_table=None,
     ):
         reg = registry
         if profiler_start is None or profiler_stop is None:
@@ -223,6 +229,22 @@ class MetricsServer:
                         self._send_json(500, {"error": str(e)})
                         return
                     if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
+                    return
+                if route == "/debug/epoch_table":
+                    # epoch_table = zero-arg callable returning the
+                    # verifier's epoch_table_snapshot(); unwired nodes
+                    # (CPU-only tier) report wired: false
+                    snap = None
+                    if epoch_table is not None:
+                        try:
+                            snap = epoch_table()
+                        except Exception as e:
+                            self._send_json(500, {"error": str(e)})
+                            return
+                    if snap is None or not snap.get("enabled", True):
                         self._send_json(200, {"wired": False})
                         return
                     self._send_json(200, {"wired": True, **snap})

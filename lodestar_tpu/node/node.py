@@ -201,6 +201,11 @@ class BeaconNode:
                 lanes=self.metrics.pipeline.lanes_snapshot,
                 slo=slo.snapshot_or_none,
                 device=device_ledger.ledger().snapshot,
+                epoch_table=(
+                    self.bls_supervisor.epoch_table_snapshot
+                    if self.bls_supervisor is not None
+                    else None
+                ),
             )
             self.metrics_server.start()
             self.log.info("metrics on :%d", self.metrics_server.port)
@@ -309,6 +314,11 @@ class BeaconNode:
             len(self.chain.op_pool.attester_slashings), kind="attester_slashings"
         )
         spe = self.config.preset.SLOTS_PER_EPOCH
+        if slot % spe == 0:
+            # epoch transition: pre-populate the device-resident pubkey
+            # table for the new epoch's active set (ISSUE 18) — off the
+            # slot path, one population in flight at a time
+            self._populate_epoch_table_async(slot // spe)
         if slot % spe == 0 and self.validator_monitor.monitored:
             epoch_now = slot // spe
             if epoch_now >= 2:
@@ -323,6 +333,44 @@ class BeaconNode:
             m.db_live_bytes.set(st["live_bytes"])
             m.db_dead_bytes.set(st["dead_bytes"])
         self.notifier.on_slot(slot)
+
+    def _populate_epoch_table_async(self, epoch: int) -> None:
+        """Decompress the epoch's active-validator pubkeys into the
+        device-resident `EpochPubkeyTable` on a background thread — the
+        reference's EpochContext pubkey cache, device-tier (ISSUE 18).
+        Committees are fixed per epoch, so after this the attestation
+        lanes read pubkey limbs with a memcpy instead of a C-tier sqrt.
+        At most one population in flight; verifiers without the seam
+        (CPU tier, mock) are skipped."""
+        populate = getattr(self.chain.bls, "epoch_table_populate", None)
+        if not callable(populate) or getattr(self, "_epoch_table_filling", False):
+            return
+        try:
+            flat = self.chain.head_state.flat
+            indices = flat.active_indices(epoch)
+            pubkeys = [flat.pubkeys[int(i)].to_bytes() for i in indices]
+        except Exception as e:
+            # phase0 test states lack the flat active-index path
+            self.log.debug("epoch-table population skipped: %s", e)
+            return
+        self._epoch_table_filling = True
+
+        def _run():
+            try:
+                rows = populate(epoch, pubkeys)
+                self.log.info(
+                    "epoch table populated: epoch %d, %d rows", epoch, rows
+                )
+            except Exception as e:
+                self.log.warning("epoch-table population failed: %s", e)
+            finally:
+                self._epoch_table_filling = False
+
+        import threading
+
+        threading.Thread(
+            target=_run, name="epoch-table-fill", daemon=True
+        ).start()
 
     def _follow_eth1_async(self) -> None:
         """Kick the deposit-log follower on a background thread, at most

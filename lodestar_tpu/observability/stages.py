@@ -290,6 +290,33 @@ class PipelineMetrics:
             "fraction of dispatched batches whose host prep overlapped "
             "device compute of an in-flight batch (double-buffering)",
         )
+        # epoch-resident crypto (round 18): the device pubkey table that
+        # turns steady-state attestation marshalling into memcpys, plus
+        # the dispatcher's H(msg) dedup at the coalescing point
+        # (parallel/epoch_table.py and chain/dispatcher.py feed these)
+        self.epoch_table_hits = r.counter(
+            "lodestar_bls_epoch_table_hits_total",
+            "pubkey rows served from the epoch-resident table "
+            "(a memcpy instead of a C-tier G1 decompression)",
+        )
+        self.epoch_table_misses = r.counter(
+            "lodestar_bls_epoch_table_misses_total",
+            "pubkey lookups the epoch table could not serve "
+            "(fell through to _pk_cache / C-tier decompress)",
+        )
+        self.epoch_table_occupancy_gauge = r.gauge(
+            "lodestar_bls_epoch_table_occupancy",
+            "decompressed pubkey rows resident across all retained epochs",
+        )
+        self.epoch_table_evictions = r.counter(
+            "lodestar_bls_epoch_table_evictions_total",
+            "pubkey rows dropped by LRU epoch rotation or the row cap",
+        )
+        self.h2c_dedup_counter = r.counter(
+            "lodestar_bls_h2c_dedup_total",
+            "duplicate hash-to-curve computations elided by message "
+            "dedup at the lane-dispatcher coalescing point",
+        )
         # compile-ledger / cold-start families (round 11): compilation is
         # the tax that killed both red driver rounds — these make every
         # compile event and the getting-to-serving path first-class
@@ -441,6 +468,21 @@ class PipelineMetrics:
     def cache_event(self, cache: str, hit: bool, n: int = 1) -> None:
         if n:
             self.cache_events.inc(n, cache=cache, outcome="hit" if hit else "miss")
+
+    def epoch_table_event(self, hit: bool, n: int = 1) -> None:
+        if n:
+            (self.epoch_table_hits if hit else self.epoch_table_misses).inc(n)
+
+    def epoch_table_occupancy(self, rows: int) -> None:
+        self.epoch_table_occupancy_gauge.set(rows)
+
+    def epoch_table_eviction(self, n: int = 1) -> None:
+        if n:
+            self.epoch_table_evictions.inc(n)
+
+    def h2c_dedup(self, n: int = 1) -> None:
+        if n:
+            self.h2c_dedup_counter.inc(n)
 
     def bisect(self, rounds: int, probes: int) -> None:
         """Record one per-set verdict batch's bisection outcome."""

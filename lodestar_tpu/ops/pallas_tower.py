@@ -1,4 +1,4 @@
-"""VMEM-resident Pallas Miller-loop tower kernel (ISSUE 14).
+"""VMEM-resident Pallas Miller-loop and fused full-pairing kernels.
 
 The XLA path (`ops/pairing.miller_loop`) lowers each Fp2/Fp6/Fp12 tower
 op of the 63 doubling/addition steps as separate HLO fusions — the
@@ -32,6 +32,23 @@ Mosaic's double buffers. Limbs ride the trailing axis as in the
 framework-wide layout; correctness-first (the win targeted here is HBM
 avoidance, not vreg occupancy — see ops/pallas_fp.py for the
 lane-transposed treatment of a single field op).
+
+FULL-PAIRING fusion (ISSUE 18): `pairing_fused_pallas` extends the same
+design from the Miller loop to the WHOLE per-set pairing tail — each tile
+runs 2·PAIRING_TILE Miller lanes (pk·H(m) lanes plus the −g1·sig lanes),
+the per-set Fp12 product, and the shared-inversion
+`final_exponentiation_batch`, all inside ONE `pl.pallas_call`: the Fp12
+accumulator never spills to HBM between the Miller loop and the final
+exp (the 820 ms floor-profile gap this targets). Bit-identicality to the
+XLA `miller_loop` + `final_exponentiation_batch` route is again by
+construction AND by grouping-invariance: `final_exponentiation_batch` is
+bit-identical to per-lane `final_exponentiation` on EVERY input (the
+tests/test_final_exp_batch.py contract), so a per-tile batched FE equals
+the full-batch one lane-for-lane — tiling cannot change verdict limbs.
+PAIRING_TILE is half of MILLER_TILE: a tile still runs 2·PAIRING_TILE
+Miller lanes (same live set as one Miller tile) and the FE hard part
+holds a handful of extra live Fp12s. Gated by LODESTAR_TPU_PALLAS_PAIRING
+(auto-on-TPU, interpreter parity on CPU), independent of the Miller knob.
 """
 
 from __future__ import annotations
@@ -46,6 +63,7 @@ from ..utils.env import env_str
 from .limbs import N_LIMBS
 
 MILLER_TILE = 8  # batch lanes per Pallas program (VMEM headroom: see above)
+PAIRING_TILE = 4  # per-set lanes per fused-pairing program (2x Miller lanes)
 
 _FALSE_VALUES = ("0", "off", "false", "no", "")
 
@@ -57,12 +75,22 @@ def _on_tpu() -> bool:
         return False
 
 
-def enabled() -> bool:
-    """Resolve the LODESTAR_TPU_PALLAS_MILLER tri-state for this process."""
-    mode = (env_str("LODESTAR_TPU_PALLAS_MILLER") or "auto").strip().lower()
+def _tri_state(name: str) -> bool:
+    mode = (env_str(name) or "auto").strip().lower()
     if mode == "auto":
         return _on_tpu()
     return mode not in _FALSE_VALUES
+
+
+def enabled() -> bool:
+    """Resolve the LODESTAR_TPU_PALLAS_MILLER tri-state for this process."""
+    return _tri_state("LODESTAR_TPU_PALLAS_MILLER")
+
+
+def pairing_enabled() -> bool:
+    """Resolve the LODESTAR_TPU_PALLAS_PAIRING tri-state for this process
+    (the fused full-pairing kernel; independent of the Miller knob)."""
+    return _tri_state("LODESTAR_TPU_PALLAS_PAIRING")
 
 
 @functools.lru_cache(maxsize=1)
@@ -170,3 +198,124 @@ def miller_loop_pallas(p_aff, q_aff, interpret: bool | None = None):
     with named_scope("bls/miller_pallas"):
         out = _miller_tiles(xp, yp, xq, yq, interpret)
     return out[:n].reshape(batch + (2, 3, 2, N_LIMBS))
+
+
+# --- fused full pairing (ISSUE 18) ------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _pairing_tile_jaxpr():
+    """Trace one fused-pairing tile — 2·PAIRING_TILE Miller lanes, the
+    per-set Fp12 products, and the shared-inversion batched final exp —
+    to a jaxpr, once.
+
+    Same const-shipping contract as `_tile_jaxpr`: the generator point,
+    the modulus, the x-bit schedules and the FE hard-part constants all
+    become jaxpr consts shipped to the kernel as extra pallas inputs.
+    The LODESTAR_TPU_FINAL_EXP_KS_CARRY knob latches at this first trace
+    exactly like the XLA `final_exponentiation_batch` compile does."""
+    from . import fp, fp12, pairing  # deferred: pairing dispatches back here
+    from .points import G1_GEN_X, G1_GEN_Y
+
+    def tile(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y):
+        n = PAIRING_TILE
+        neg_gy = fp.neg(G1_GEN_Y)
+        xs = jnp.concatenate(
+            [pk_x, jnp.broadcast_to(G1_GEN_X, (n, N_LIMBS))], 0
+        )
+        ys = jnp.concatenate([pk_y, jnp.broadcast_to(neg_gy, (n, N_LIMBS))], 0)
+        qx = jnp.concatenate([msg_x, sig_x], 0)
+        qy = jnp.concatenate([msg_y, sig_y], 0)
+        fs = pairing._miller_loop_impl(xs, ys, None, qx, qy, None)
+        prod = fp12.mul(fs[:n], fs[n:])
+        return pairing.final_exponentiation_batch(prod)
+
+    struct = jax.ShapeDtypeStruct
+    p = struct((PAIRING_TILE, N_LIMBS), jnp.int32)
+    q = struct((PAIRING_TILE, 2, N_LIMBS), jnp.int32)
+    return jax.make_jaxpr(tile)(p, p, q, q, q, q)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pairing_tiles(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, interpret: bool):
+    """pk (n, 32), msg/sig (n, 2, 32) with n % PAIRING_TILE == 0 →
+    final-exponentiated per-set Fp12 limbs (n, 2, 3, 2, 32).
+
+    Each program replays the whole pairing of one tile on VMEM-resident
+    values: the Miller accumulators, the per-set products and every FE
+    intermediate stay on-core; one HBM round-trip per tile total."""
+    from jax import core as jax_core
+    from jax.experimental import pallas as pl
+
+    closed = _pairing_tile_jaxpr()
+    consts = [jnp.asarray(c) for c in closed.consts]
+    shipped = [c.reshape((1,) * max(0, 2 - c.ndim) + c.shape) for c in consts]
+
+    def kernel(*refs):
+        (*c_refs, px_ref, py_ref, mx_ref, my_ref, sx_ref, sy_ref,
+         out_ref) = refs
+        cvals = [r[...].reshape(c.shape) for r, c in zip(c_refs, consts)]
+        (res,) = jax_core.eval_jaxpr(
+            closed.jaxpr, cvals,
+            px_ref[...], py_ref[...], mx_ref[...], my_ref[...],
+            sx_ref[...], sy_ref[...],
+        )
+        out_ref[...] = res
+
+    n = pk_x.shape[0]
+
+    def _const_spec(c):
+        return pl.BlockSpec(c.shape, lambda i, _nd=c.ndim: (0,) * _nd)
+
+    spec_p = pl.BlockSpec((PAIRING_TILE, N_LIMBS), lambda i: (i, 0))
+    spec_q = pl.BlockSpec((PAIRING_TILE, 2, N_LIMBS), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // PAIRING_TILE,),
+        in_specs=[_const_spec(c) for c in shipped]
+        + [spec_p, spec_p, spec_q, spec_q, spec_q, spec_q],
+        out_specs=pl.BlockSpec(
+            (PAIRING_TILE, 2, 3, 2, N_LIMBS), lambda i: (i, 0, 0, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, 2, 3, 2, N_LIMBS), jnp.int32),
+        interpret=interpret,
+    )(*shipped, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y)
+
+
+def pairing_fused_pallas(pk_aff, msg_aff, sig_aff, interpret: bool | None = None):
+    """Fused per-set pairing: final_exp(e-terms of e(pk_i, H(m_i)) ·
+    e(−g1, sig_i)) limbs for every lane, VMEM-resident end to end.
+
+    pk (xp, yp) limbs (n, 32); msg/sig (x, y) limbs (n, 2, 32). Returns
+    the final-exponentiated Fp12 limbs (n, 2, 3, 2, 32) — callers finish
+    with `fp12.is_one(...) & valid` exactly like the XLA route finishes
+    `final_exponentiation_batch`. Bit-identical to
+    `_individual_pairing_terms` + `final_exponentiation_batch` on every
+    lane: the tile jaxpr composes those very functions, and the batched
+    FE's per-lane-identical contract makes the tiling invisible. Padding
+    lanes added to fill the last tile are garbage-in/sliced-off (the
+    zero-lane guard inside the FE keeps the Montgomery prefix product
+    finite for any input). `interpret` defaults to automatic: compiled
+    on TPU, the Pallas interpreter elsewhere (the CPU differential
+    suite)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    pk_x, pk_y = pk_aff
+    msg_x, msg_y = msg_aff
+    sig_x, sig_y = sig_aff
+    n = pk_x.shape[0]
+    pad = (-n) % PAIRING_TILE
+    if pad:
+        zp = jnp.zeros((pad, N_LIMBS), pk_x.dtype)
+        zq = jnp.zeros((pad, 2, N_LIMBS), msg_x.dtype)
+        pk_x = jnp.concatenate([pk_x, zp], 0)
+        pk_y = jnp.concatenate([pk_y, zp], 0)
+        msg_x = jnp.concatenate([msg_x, zq], 0)
+        msg_y = jnp.concatenate([msg_y, zq], 0)
+        sig_x = jnp.concatenate([sig_x, zq], 0)
+        sig_y = jnp.concatenate([sig_y, zq], 0)
+    with named_scope("bls/pairing_pallas"):
+        out = _pairing_tiles(
+            pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, interpret
+        )
+    return out[:n]

@@ -1,0 +1,213 @@
+"""Epoch-scoped device-resident pubkey table (ISSUE 18).
+
+The reference beacon node never decompresses a pubkey on the hot path:
+its `EpochContext.index2pubkey` holds every active validator's
+deserialized point for the whole epoch (PAPER.md §L2), because committees
+are fixed per epoch — the steady-state attestation workload reads the
+same pubkeys thousands of times between transitions. This module is the
+device-tier analog, shaped like a resident weight table in a serving
+stack:
+
+- One `_EpochEntry` per (epoch, validator-index set): a packed
+  (rows, 2·N_LIMBS) int32 limb array (x‖y per row, the `_pk_cache` row
+  format) living BOTH as a host numpy mirror (serves the host marshal
+  path with a memcpy instead of a C-tier sqrt) and, when `jax.device_put`
+  succeeds, as a device array gathered through the compile-ledger-wrapped
+  `epoch_table` kernel.
+- LRU rotation over LODESTAR_TPU_EPOCH_TABLE_EPOCHS entries (default 2 —
+  current + next, the reference's EpochContext pair): populating epoch
+  N+1 evicts epoch N−1.
+- Device OOM (or any device_put failure) downgrades the entry to
+  host-only — lookups keep working off the numpy mirror, and the
+  verifier's bounded FIFO `_pk_cache` remains the fallback for keys the
+  table never saw (exited validators, deposits mid-epoch).
+
+`TpuBlsVerifier._pk_rows` consults the table FIRST, then `_pk_cache`,
+then pays the C-tier decompression; `node.py` populates at epoch
+transition on a daemon thread; `tools/warmup.py` has a rung; hit/miss/
+occupancy/eviction land in the `lodestar_bls_epoch_table_*` families and
+`/debug/epoch_table`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+N_LIMBS = 32
+ROW_WIDTH = 2 * N_LIMBS  # packed x‖y limbs, the _pk_cache row format
+
+
+class _EpochEntry:
+    """One epoch's packed pubkey rows + key→row index."""
+
+    __slots__ = ("epoch", "rows_np", "rows_dev", "index", "device_resident")
+
+    def __init__(self, epoch: int, rows_np: np.ndarray, index: dict):
+        self.epoch = int(epoch)
+        self.rows_np = rows_np
+        self.rows_dev = None
+        self.index = index
+        self.device_resident = False
+
+
+def _gather_kernel(table, idx):
+    """Device gather of packed pubkey rows — the epoch-table compile
+    unit (`epoch_table` in the ledger and the warmup ladder)."""
+    return table[idx]
+
+
+class EpochPubkeyTable:
+    """Device-resident decompressed G1 limbs keyed by epoch, LRU over a
+    bounded number of epochs, host-mirror lookups for the marshal path.
+
+    Thread-safe: gossip executors look rows up while the node's epoch-
+    transition thread populates the next entry."""
+
+    def __init__(self, epochs: int | None = None, max_rows: int | None = None,
+                 observer=None):
+        from ..observability.stages import default_pipeline
+        from ..utils.env import env_int
+
+        self.epochs = (
+            env_int("LODESTAR_TPU_EPOCH_TABLE_EPOCHS")
+            if epochs is None else int(epochs)
+        )
+        self.max_rows = (
+            env_int("LODESTAR_TPU_EPOCH_TABLE_MAX_ROWS")
+            if max_rows is None else int(max_rows)
+        )
+        self.observer = observer if observer is not None else default_pipeline()
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[int, _EpochEntry] = OrderedDict()  # guarded-by: _lock
+        self._evictions = 0  # guarded-by: _lock
+        self._device_failures = 0  # guarded-by: _lock
+        # jit + ledger-wrap lazily: constructing a table must not touch
+        # the device (tests build them on import-time paths)
+        self._gather = None
+        self._gather_lock = threading.Lock()
+
+    # -- population (epoch transition / warmup) -----------------------------
+
+    def populate(self, epoch: int, items) -> int:
+        """Install one epoch's entry from `items` — an iterable of
+        (pubkey_bytes, packed_row) pairs, packed_row a (2·N_LIMBS,) int32
+        array (the `_pk_cache` row format). Returns rows installed.
+
+        Re-populating an existing epoch replaces it (validator set grew
+        mid-epoch); rows beyond `max_rows` are dropped and counted as
+        evictions. The device upload is best-effort: an OOM (or any
+        device_put failure) leaves a host-only entry and ticks the
+        failure counter — lookups degrade to the numpy mirror, never
+        raise."""
+        index: dict[bytes, int] = {}
+        rows: list[np.ndarray] = []
+        truncated = 0
+        for key, row in items:
+            if len(index) >= self.max_rows:
+                truncated += 1
+                continue
+            if key in index:
+                continue
+            index[key] = len(rows)
+            rows.append(row)
+        rows_np = (
+            np.stack(rows).astype(np.int32)
+            if rows else np.zeros((0, ROW_WIDTH), np.int32)
+        )
+        entry = _EpochEntry(epoch, rows_np, index)
+        entry.device_resident = self._try_device_put(entry)
+        with self._lock:
+            self._entries.pop(int(epoch), None)
+            self._entries[int(epoch)] = entry
+            if truncated:
+                self._evictions += truncated
+                self.observer.epoch_table_eviction(truncated)
+            while len(self._entries) > max(1, self.epochs):
+                old_epoch, old = self._entries.popitem(last=False)
+                self._evictions += old.rows_np.shape[0]
+                self.observer.epoch_table_eviction(old.rows_np.shape[0])
+            self._refresh_occupancy_locked()
+        return rows_np.shape[0]
+
+    def _try_device_put(self, entry: _EpochEntry) -> bool:
+        if entry.rows_np.shape[0] == 0:
+            return False
+        try:
+            import jax
+
+            entry.rows_dev = jax.device_put(entry.rows_np)
+            return True
+        except Exception:
+            with self._lock:
+                self._device_failures += 1
+            entry.rows_dev = None
+            return False
+
+    # -- lookup (hot path) ---------------------------------------------------
+
+    def lookup_rows(self, keys) -> list:
+        """Packed (2·N_LIMBS,) rows (host mirror) for each pubkey-bytes
+        key, None per miss. One counter tick per batch, not per key."""
+        hits: list = [None] * len(keys)
+        n_hit = 0
+        with self._lock:
+            entries = list(self._entries.values())
+        for i, k in enumerate(keys):
+            for e in reversed(entries):  # newest epoch first
+                row = e.index.get(k)
+                if row is not None:
+                    hits[i] = e.rows_np[row]
+                    n_hit += 1
+                    break
+        self.observer.epoch_table_event(True, n=n_hit)
+        self.observer.epoch_table_event(False, n=len(keys) - n_hit)
+        return hits
+
+    def gather_device(self, epoch: int, idx) -> "object | None":
+        """Device gather of rows `idx` from one epoch's device-resident
+        array through the ledger-wrapped kernel; None when the entry is
+        absent or host-only (callers fall back to the host mirror)."""
+        with self._lock:
+            entry = self._entries.get(int(epoch))
+        if entry is None or not entry.device_resident:
+            return None
+        if self._gather is None:
+            with self._gather_lock:
+                if self._gather is None:
+                    import jax
+
+                    from ..observability.compile_ledger import ledger
+
+                    self._gather = ledger().wrap(
+                        jax.jit(_gather_kernel), "epoch_table"
+                    )
+        return self._gather(entry.rows_dev, np.asarray(idx, np.int32))
+
+    # -- observability -------------------------------------------------------
+
+    def _refresh_occupancy_locked(self) -> None:
+        rows = sum(e.rows_np.shape[0] for e in self._entries.values())
+        self.observer.epoch_table_occupancy(rows)
+
+    def snapshot(self) -> dict:
+        """State for `/debug/epoch_table` and the bench document."""
+        with self._lock:
+            entries = [
+                {
+                    "epoch": e.epoch,
+                    "rows": int(e.rows_np.shape[0]),
+                    "device_resident": bool(e.device_resident),
+                }
+                for e in self._entries.values()
+            ]
+            return {
+                "epochs_retained": self.epochs,
+                "max_rows": self.max_rows,
+                "entries": entries,
+                "total_rows": sum(en["rows"] for en in entries),
+                "evictions": self._evictions,
+                "device_put_failures": self._device_failures,
+            }

@@ -454,6 +454,12 @@ def individual_verify_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
     2N Miller loops and N final exponentiations run batched. Returns
     (N,) bool; padding lanes report False.
     """
+    if pallas_tower.pairing_enabled():
+        # whole pairing (Miller loop + batched final exp) fused per tile in
+        # VMEM — no HBM spill of the Fp12 accumulator between the two halves
+        fe = pallas_tower.pairing_fused_pallas(
+            (pk_x, pk_y), (msg_x, msg_y), (sig_x, sig_y))
+        return fp12.is_one(fe) & valid
     prod = _individual_pairing_terms(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y)
     # the (N,)-wide batched final exp is the per-set path's latency win:
     # ONE shared easy-part inversion chain instead of N (ISSUE 14)
@@ -466,7 +472,7 @@ def individual_verify_kernel_legacy_fe(
 ):
     """The pre-batching per-set verdict tail: N independent per-lane
     final exponentiations (one Fermat inversion chain EACH). Kept only
-    as the bench `floor_batched_fe` comparison baseline — never
+    as the bench `floor_fused_pairing` comparison baseline — never
     dispatched in production; must stay verdict-identical to
     `individual_verify_kernel`."""
     prod = _individual_pairing_terms(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y)
@@ -555,6 +561,18 @@ def miller_pallas_kernel(pk_x, pk_y, msg_x, msg_y):
     (production kernels route here implicitly via `pairing.miller_loop`
     when the knob resolves on)."""
     return pallas_tower.miller_loop_pallas((pk_x, pk_y), (msg_x, msg_y))
+
+
+def pairing_pallas_kernel(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, valid):
+    """Per-set verdicts forced through the VMEM-resident fused
+    full-pairing Pallas kernel (ops/pallas_tower.py) regardless of the
+    LODESTAR_TPU_PALLAS_PAIRING knob — the warmup/ledger compile unit
+    for the fused path (`individual_verify_kernel` routes here
+    implicitly when the knob resolves on). Verdict-identical to the XLA
+    `miller_loop` + `final_exponentiation_batch` route."""
+    fe = pallas_tower.pairing_fused_pallas(
+        (pk_x, pk_y), (msg_x, msg_y), (sig_x, sig_y))
+    return fp12.is_one(fe) & valid
 
 
 class SetArrays:
@@ -737,6 +755,11 @@ class BatchVerifier:
         self._miller_pallas = _wrap(
             jax.jit(miller_pallas_kernel), "miller_pallas"
         )
+        # ISSUE 18 compile unit: the fused full-pairing Pallas kernel
+        # (Miller loop + batched final exp, VMEM-resident per tile)
+        self._pairing_pallas = _wrap(
+            jax.jit(pairing_pallas_kernel), "pairing_pallas"
+        )
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -815,6 +838,16 @@ class BatchVerifier:
         same kernel via `ops.pairing.miller_loop` when
         LODESTAR_TPU_PALLAS_MILLER resolves on."""
         return self._miller_pallas(p_aff[0], p_aff[1], q_aff[0], q_aff[1])
+
+    def pairing_pallas(self, arrs: SetArrays):
+        """Per-set verdicts through the fused full-pairing Pallas kernel
+        regardless of the LODESTAR_TPU_PALLAS_PAIRING knob — warmup rung
+        and /debug/compiles entry; production dispatch reaches the same
+        kernel via `individual_verify_kernel` when the knob resolves on."""
+        return self._pairing_pallas(
+            arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+            arrs.sig_x, arrs.sig_y, arrs.valid,
+        )
 
 
 class TpuBlsVerifier:
@@ -902,6 +935,17 @@ class TpuBlsVerifier:
             self._mesh = auto_mesh(self.observer)
         else:
             self._mesh = mesh or None
+        # Epoch-scoped pubkey table (ISSUE 18): committees are fixed per
+        # epoch, so node.py pre-populates decompressed G1 limbs for the
+        # whole active set at epoch transition; `_pk_rows` consults the
+        # table before paying the C-tier sqrt, and the bounded `_pk_cache`
+        # above stays as the fallback for keys the table never saw.
+        if env_bool("LODESTAR_TPU_EPOCH_TABLE"):
+            from .epoch_table import EpochPubkeyTable
+
+            self._epoch_table = EpochPubkeyTable(observer=self.observer)
+        else:
+            self._epoch_table = None
 
     # -- mesh passthroughs (supervisor failure policy) ----------------------
 
@@ -972,6 +1016,16 @@ class TpuBlsVerifier:
         self.observer.cache_event("pk", False, n=len(misses))
         if misses:
             fresh = {}
+            # epoch table first: a hit is a memcpy off the host mirror
+            # instead of a C-tier Fp sqrt (ISSUE 18)
+            if self._epoch_table is not None:
+                miss_keys = list(misses)
+                for k, row in zip(
+                    miss_keys, self._epoch_table.lookup_rows(miss_keys)
+                ):
+                    if row is not None:
+                        fresh[k] = row
+                        misses.discard(k)
             for k in misses:
                 rc, limbs = _native.bls_g1_decompress(k, check_subgroup=False)
                 if rc != 0:
@@ -994,6 +1048,59 @@ class TpuBlsVerifier:
             pk_x[i] = r[:N_LIMBS]
             pk_y[i] = r[N_LIMBS:]
         return pk_x, pk_y
+
+    # -- epoch-scoped precomputation (ISSUE 18) -----------------------------
+
+    def warm_h2c(self, messages) -> int:
+        """Pre-warm the hash-to-curve cache for 32-byte signing roots —
+        the dispatcher's H(msg) dedup seam: one hash_to_g2 per UNIQUE
+        attestation data across a coalesced flush, after which the
+        marshal path hits `_h2c_cache` for every duplicate. Returns the
+        number of roots hashed (misses)."""
+        hashed = 0
+        for m in messages:
+            if len(m) != 32:
+                continue
+            with self._h2c_lock:
+                hit = m in self._h2c_cache
+            if not hit:
+                if self._hash_root(m) is not None:
+                    hashed += 1
+        return hashed
+
+    def epoch_table_populate(self, epoch: int, pubkeys) -> int:
+        """Install one epoch's device-resident pubkey table entry from an
+        iterable of compressed pubkey bytes (node.py calls this at epoch
+        transition with the active validator set). Decompression happens
+        once per key here — off the dispatch path — reusing `_pk_cache`
+        rows when present. Returns rows installed; 0 when the table is
+        disabled or a key is malformed (population is best-effort: the
+        dispatch path keeps its own fallbacks)."""
+        from .. import native as _native
+
+        if self._epoch_table is None:
+            return 0
+        items = []
+        for k in pubkeys:
+            k = bytes(k)
+            with self._pk_lock:
+                row = self._pk_cache.get(k)
+            if row is None:
+                rc, limbs = _native.bls_g1_decompress(k, check_subgroup=False)
+                if rc != 0:
+                    continue  # skip malformed/infinity, keep the rest
+                row = np.concatenate((limbs[0], limbs[1]))
+            items.append((k, row))
+        return self._epoch_table.populate(epoch, items)
+
+    def epoch_table_snapshot(self):
+        """Epoch-table state for `/debug/epoch_table`; {"enabled": False}
+        when LODESTAR_TPU_EPOCH_TABLE is off."""
+        if self._epoch_table is None:
+            return {"enabled": False}
+        snap = self._epoch_table.snapshot()
+        snap["enabled"] = True
+        return snap
 
     def _native_limbs(self, sets):
         """Per-set (pk_x, pk_y, sig_x, sig_y) limb arrays via the C tier

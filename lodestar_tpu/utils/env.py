@@ -75,6 +75,12 @@ _register("LODESTAR_TPU_PALLAS_MILLER", "str", "auto",
           "(ops/pallas_tower.py): auto (on when the backend lowers "
           "Pallas, i.e. TPU), 1/on (forced; interpreter mode off-TPU), "
           "0/off.")
+_register("LODESTAR_TPU_PALLAS_PAIRING", "str", "auto",
+          "VMEM-resident fused FULL-pairing Pallas kernel (Miller loop + "
+          "batched final exponentiation in one tile, ops/pallas_tower.py): "
+          "auto (on when the backend lowers Pallas, i.e. TPU), 1/on "
+          "(forced; interpreter mode off-TPU), 0/off. Routes the per-set "
+          "verdict kernel's whole pairing tail.")
 _register("LODESTAR_TPU_FINAL_EXP_KS_CARRY", "bool", False,
           "Route the final-exp hard part's carries through the scan-free "
           "Kogge-Stone form (fp.ks_carry) inside the batched final-exp "
@@ -96,6 +102,23 @@ _register("LODESTAR_TPU_PK_CACHE_MAX", "int", 1 << 21,
           "Bounded FIFO pubkey-decompression cache entries (~550 B "
           "each); below the active validator set it thrashes to 0% "
           "hits.")
+_register("LODESTAR_TPU_EPOCH_TABLE", "bool", True,
+          "Epoch-scoped device-resident pubkey table "
+          "(parallel/epoch_table.py): decompressed G1 limbs for the "
+          "active validator set, populated at epoch transition; off "
+          "keeps the per-dispatch FIFO pubkey cache only.")
+_register("LODESTAR_TPU_EPOCH_TABLE_EPOCHS", "int", 2,
+          "Epoch entries the pubkey table retains (LRU rotation); the "
+          "reference keeps current+next EpochContext the same way.")
+_register("LODESTAR_TPU_EPOCH_TABLE_MAX_ROWS", "int", 1 << 21,
+          "Row cap per epoch entry of the device pubkey table (~256 B "
+          "of limb data each); populate calls beyond it are truncated "
+          "and counted as evictions.")
+_register("LODESTAR_TPU_H2C_DEDUP", "bool", True,
+          "Hash-to-curve dedup across coalesced aggregates at the lane "
+          "dispatcher: duplicate messages in one merged batch pay one "
+          "hash_to_g2 (pre-warmed through the h2c cache); off restores "
+          "per-request hashing.")
 _register("LODESTAR_TPU_MARSHAL_THREADS", "int", None,
           "Host marshal thread-pool size override (default: cpu_count; "
           "0 disables the pool).")

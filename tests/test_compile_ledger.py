@@ -205,6 +205,8 @@ def test_batch_verifier_kernels_are_ledger_wrapped():
         ("_batch_raw", "batch_raw"),
         ("_grouped_raw", "grouped_raw"),
         ("_pk_grouped_raw", "pk_grouped_raw"),
+        # ISSUE 18: the fused full-pairing Pallas kernel
+        ("_pairing_pallas", "pairing_pallas"),
     ):
         assert getattr(bv, attr).__compile_ledger_kernel__ == kernel
 

@@ -326,6 +326,59 @@ def test_metrics_server_debug_mesh_endpoint():
         server.close()
 
 
+def test_metrics_server_debug_epoch_table_endpoint():
+    """/debug/epoch_table serves the table snapshot when wired (ISSUE 18),
+    reports wired:false when the table is disabled or absent, and maps a
+    snapshot-callable failure to a 500 instead of killing the server."""
+    import urllib.request
+
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+
+    snap = {
+        "enabled": True,
+        "epochs_retained": 2,
+        "max_rows": 64,
+        "entries": [{"epoch": 7, "rows": 4, "device_resident": False}],
+        "total_rows": 4,
+        "evictions": 0,
+        "device_put_failures": 0,
+    }
+    server = MetricsServer(MetricsRegistry(), port=0, epoch_table=lambda: snap)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/epoch_table"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": True, **snap}
+    finally:
+        server.close()
+
+    # knob off -> the verifier-side snapshot says enabled:false
+    for snap_fn in (lambda: {"enabled": False}, lambda: None, None):
+        server = MetricsServer(MetricsRegistry(), port=0, epoch_table=snap_fn)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/epoch_table"
+            with urllib.request.urlopen(url) as r:
+                assert json.load(r) == {"wired": False}
+        finally:
+            server.close()
+
+    def boom():
+        raise RuntimeError("snapshot lock poisoned")
+
+    server = MetricsServer(MetricsRegistry(), port=0, epoch_table=boom)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/epoch_table"
+        try:
+            urllib.request.urlopen(url)
+            assert False, "expected 500"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+    finally:
+        server.close()
+
+
 # --- bench emitter -----------------------------------------------------------
 
 

@@ -91,3 +91,117 @@ def test_interpret_full_width_parity():
     ref = _ref_jit(p[0], p[1], q[0], q[1])
     out = pt.miller_loop_pallas(p, q, interpret=True)
     assert bool(jnp.all(out == ref))
+
+
+# --- fused full-pairing kernel (ISSUE 18) ------------------------------------
+#
+# pairing_fused_pallas replays the exact `_miller_loop_impl` + `fp12.mul`
+# + `final_exponentiation_batch` jaxpr per PAIRING_TILE-lane tile, so the
+# final-exponentiated outputs must be BIT-identical to the XLA route.
+# `final_exponentiation_batch` is per-lane identical on every input
+# (tests/test_final_exp_batch.py), so tiling cannot change any lane.
+
+from lodestar_tpu.ops import fp as _fp
+from lodestar_tpu.ops import fp12 as _fp12
+from lodestar_tpu.ops.points import G1_GEN_X, G1_GEN_Y
+
+
+def _pairing_batch(n):
+    """(pk, msg, sig) affine limb stacks for n random sets (not valid
+    signatures — parity needs arbitrary curve points, not verdicts)."""
+    pk, msg = _batch(n)
+    _, sig = _batch(n)
+    return pk, msg, sig
+
+
+def _ref_fused(pk, msg, sig):
+    """The XLA production route: one Miller loop over 2n lanes, per-set
+    product, shared-inversion batched final exp."""
+    n = pk[0].shape[0]
+    neg_gy = _fp.neg(G1_GEN_Y)
+    xs = jnp.concatenate([pk[0], jnp.broadcast_to(G1_GEN_X, (n, 32))], 0)
+    ys = jnp.concatenate([pk[1], jnp.broadcast_to(neg_gy, (n, 32))], 0)
+    qx = jnp.concatenate([msg[0], sig[0]], 0)
+    qy = jnp.concatenate([msg[1], sig[1]], 0)
+    fs = dp._miller_loop_impl(xs, ys, None, qx, qy, None)
+    return dp.final_exponentiation_batch(_fp12.mul(fs[:n], fs[n:]))
+
+
+def test_pairing_enabled_tri_state(monkeypatch):
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "0")
+    assert not pt.pairing_enabled()
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "off")
+    assert not pt.pairing_enabled()
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "1")
+    assert pt.pairing_enabled()
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "auto")
+    assert pt.pairing_enabled() == pt._on_tpu()
+    # the two Pallas knobs are independent: forcing the pairing knob must
+    # not flip the Miller-tower dispatch, and vice versa
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_MILLER", "0")
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "1")
+    assert pt.pairing_enabled() and not pt.enabled()
+
+
+def test_individual_kernel_dispatches_to_fused_when_forced(monkeypatch):
+    # individual_verify_kernel is the production seam: with the knob
+    # forced on it must route pairing_fused_pallas and finish with
+    # is_one(fe) & valid (stubbed here — the real kernel's interpret-mode
+    # parity is the slow tier below)
+    from lodestar_tpu.parallel import verifier as pv
+
+    n = 3
+    calls = []
+
+    def _stub(pk_aff, msg_aff, sig_aff, interpret=None):
+        calls.append(pk_aff[0].shape)
+        return _fp12.one((n,))
+
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "1")
+    monkeypatch.setattr(pt, "pairing_fused_pallas", _stub)
+    pk, msg, sig = _pairing_batch(n)
+    valid = jnp.array([True, True, False])
+    out = pv.individual_verify_kernel(
+        pk[0], pk[1], msg[0], msg[1], sig[0], sig[1], valid
+    )
+    assert calls == [(n, 32)]
+    # stubbed fe == 1 in every lane: verdicts reduce to the valid mask
+    assert np.array_equal(np.asarray(out), [True, True, False])
+
+
+def test_individual_kernel_ignores_fused_when_off(monkeypatch):
+    from lodestar_tpu.parallel import verifier as pv
+
+    def _boom(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("fused path dispatched with the knob off")
+
+    monkeypatch.setenv("LODESTAR_TPU_PALLAS_PAIRING", "0")
+    monkeypatch.setattr(pt, "pairing_fused_pallas", _boom)
+    pk, msg, sig = _pairing_batch(2)
+    out = pv.individual_verify_kernel(
+        pk[0], pk[1], msg[0], msg[1], sig[0], sig[1], jnp.array([True, True])
+    )
+    assert out.shape == (2,)
+
+
+@pytest.mark.slow
+def test_pairing_interpret_parity_one_tile():
+    # one full tile: fused interpret output vs the XLA route, bit-identical
+    pk, msg, sig = _pairing_batch(pt.PAIRING_TILE)
+    ref = _ref_fused(pk, msg, sig)
+    out = pt.pairing_fused_pallas(pk, msg, sig, interpret=True)
+    assert out.shape == ref.shape
+    assert bool(jnp.all(out == ref))
+
+
+@pytest.mark.slow
+def test_pairing_interpret_parity_padding_boundary():
+    # deliberately NOT a tile multiple (2 tiles + 1 lane): the zero-point
+    # padding lanes ride the final tile through the full pairing and are
+    # sliced off — they must not disturb any live lane
+    n = 2 * pt.PAIRING_TILE + 1
+    pk, msg, sig = _pairing_batch(n)
+    ref = _ref_fused(pk, msg, sig)
+    out = pt.pairing_fused_pallas(pk, msg, sig, interpret=True)
+    assert out.shape == ref.shape
+    assert bool(jnp.all(out == ref))

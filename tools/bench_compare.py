@@ -70,9 +70,10 @@ DEFAULT_THRESHOLD = 3.0
 # after any `phase.` prefix): the unconditional per-set floor and the
 # default-configuration wire-to-verdict rate
 REQUIRED_GATED_KEYS = (
-    # emitted by the parity-gated `floor_batched_fe` phase since ISSUE 14
-    # (previously `worst_case`); base-name matching carries the trend
-    # across the phase rename, same kernel + shape on both sides
+    # emitted by the parity-gated `floor_fused_pairing` phase (named
+    # `floor_batched_fe` in ISSUE 14, `worst_case` before); base-name
+    # matching carries the trend across the phase renames, same kernel +
+    # shape on both sides
     "device_sets_per_sec_floor_distinct_pk_and_msg",
     "e2e_wire_to_verdict_sets_per_sec",
     # the mesh-native serving rate (round-7 tentpole): the grouped kernel
@@ -82,6 +83,13 @@ REQUIRED_GATED_KEYS = (
     # the facade with a mesh attached, signature bytes decompressed
     # on-device per chip — the e2e acceptance row for mesh ingest
     "e2e_mesh_raw_sets_per_sec",
+    # ISSUE 18: the fused full-pairing rate (emitted only where the
+    # Pallas pairing knob resolves on — TPU deploys; absent history
+    # skips the gate, so CPU-only rounds stay green)
+    "device_sets_per_sec_fused_pairing",
+    # ISSUE 18: the epoch-warm attestation-lane host-marshal rate (the
+    # epoch table + H(msg) dedup win; parity-gated in its phase)
+    "attestation_epoch_warm_sets_per_sec",
 )
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")

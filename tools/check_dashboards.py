@@ -74,6 +74,16 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_tpu_compile_cumulative_seconds",
         "lodestar_tpu_compile_cache_entries",
         "lodestar_tpu_compile_cache_pruned_bytes_total",
+        # epoch-resident crypto families (ISSUE 18): the device pubkey
+        # table's hit rate / occupancy / rotation and the dispatcher's
+        # H(msg) dedup — a table that silently stopped serving (0% hits
+        # after an OOM downgrade or a wedged population thread) must be
+        # visible, not only in /debug/epoch_table
+        "lodestar_bls_epoch_table_hits_total",
+        "lodestar_bls_epoch_table_misses_total",
+        "lodestar_bls_epoch_table_occupancy",
+        "lodestar_bls_epoch_table_evictions_total",
+        "lodestar_bls_h2c_dedup_total",
     ),
     # cold-start / runtime-identity families (ISSUE 11): the
     # serving-ready SLO and build info belong on the fleet summary

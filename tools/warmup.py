@@ -22,8 +22,10 @@ steady-state: per-set buckets (4, 16, 64, 128) + grouped configs
 unique-root flood defense routes here) + the bisection-verdict tree
 kernel per bucket and its fixed-shape probe kernel (the per-set verdict
 path, round 6) + the standalone batched final exp and — when
-LODESTAR_TPU_PALLAS_MILLER resolves on — the Pallas Miller tower
-(ISSUE 14) + the bench shapes when --bench is given. Device
+LODESTAR_TPU_PALLAS_MILLER / LODESTAR_TPU_PALLAS_PAIRING resolve on —
+the Pallas Miller tower (ISSUE 14) and the fused full-pairing kernel
+(ISSUE 18) + the epoch-table gather kernel + the bench shapes when
+--bench is given. Device
 decompression is DEFAULT-ON (round 6), so the *_raw kernel variants —
 on-chip signature decode + subgroup checks — are warmed for the same
 shapes by default; LODESTAR_TPU_DEVICE_DECOMPRESS=0 (or
@@ -170,6 +172,37 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
         print(f"miller pallas x{buckets[0]}: {time.monotonic() - t0:.1f}s",
               flush=True)
         timeline().mark("rung_miller_pallas")
+    # the fused full-pairing kernel (ISSUE 18): same gating logic — on
+    # TPU deploys the per-set verdict path routes here, so its compile
+    # belongs in the ladder; the CPU interpreter path stays a
+    # differential-test vehicle
+    if pallas_tower.pairing_enabled():
+        arrs = SetArrays(buckets[0])
+        (arrs.pk_x, arrs.pk_y, arrs.msg_x, arrs.msg_y,
+         arrs.sig_x, arrs.sig_y, _r_bits, arrs.valid) = _example_arrays(
+            buckets[0]
+        )
+        arrs.n = buckets[0]
+        t0 = time.monotonic()
+        out = bv.pairing_pallas(arrs)
+        jax.block_until_ready(out)
+        print(f"pairing pallas x{buckets[0]}: {time.monotonic() - t0:.1f}s",
+              flush=True)
+        timeline().mark("rung_pairing_pallas")
+    # the epoch-table gather kernel (ISSUE 18): one tiny compile that
+    # otherwise lands on the first post-restart epoch transition
+    from lodestar_tpu.parallel.epoch_table import ROW_WIDTH, EpochPubkeyTable
+
+    table = EpochPubkeyTable(epochs=1, max_rows=8)
+    table.populate(0, [(bytes([i]) * 48, np.zeros(ROW_WIDTH, np.int32))
+                       for i in range(4)])
+    t0 = time.monotonic()
+    gathered = table.gather_device(0, np.arange(4))
+    if gathered is not None:
+        jax.block_until_ready(gathered)
+    print(f"epoch table gather x4: {time.monotonic() - t0:.1f}s "
+          f"device={gathered is not None}", flush=True)
+    timeline().mark("rung_epoch_table")
     for rows, lanes in grouped:
         if device_decompress:
             g, a_bits, b_bits, sig_raw = _example_grouped(rows, lanes, raw=True)
