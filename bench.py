@@ -969,7 +969,19 @@ def main() -> None:
         _log(f"bench: grouped {rate:.1f} sets/s")
     # first production-shape phase done == this process could serve; the
     # mark is the bench's serving-ready SLO sample (cold vs warm cache)
-    timeline().mark_serving_ready()
+    t_ready = timeline().mark_serving_ready()
+    # cold-start rows (ISSUE 19): serving_ready_seconds is a GATED
+    # bench_compare key (time direction: growth fails the round), and the
+    # per-round AOT store outcomes say WHY it moved — a round that loaded
+    # executables from disk vs one that compiled reads differently here
+    with em.phase("cold_start") as ph:
+        aot_counts = ledger().snapshot()["aot"]["counts"]
+        ph.record("serving_ready_seconds", round(t_ready, 3))
+        ph.record("aot_hits", aot_counts.get("hit", 0))
+        ph.record("aot_misses", aot_counts.get("miss", 0))
+        ph.record("aot_exports", aot_counts.get("export", 0))
+        ph.record("aot_rejected", aot_counts.get("corrupt", 0)
+                  + aot_counts.get("version_mismatch", 0))
     # wider lane buckets amortize the 2R+64-Miller fixed cost further;
     # the HEADLINE takes the best shape, but each shape's rate is
     # recorded under its own phase (no cross-shape mislabeling)

@@ -283,10 +283,15 @@ class MetricsServer:
                     except ValueError:
                         self._send_json(400, {"error": "bad limit"})
                         return
+                    led_snap = compile_ledger.ledger().snapshot()
                     self._send_json(
                         200,
                         {
-                            "ledger": compile_ledger.ledger().snapshot(),
+                            "ledger": led_snap,
+                            # surfaced top-level too: the AOT restart
+                            # story (store dir, hit/corrupt counts,
+                            # loaded executables) is its own section
+                            "aot": led_snap.get("aot"),
                             "startup": compile_ledger.timeline().snapshot(),
                             "flight_recorder":
                                 flight_recorder.recorder().dump(limit=limit),

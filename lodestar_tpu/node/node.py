@@ -134,6 +134,21 @@ class BeaconNode:
             verifier = BlsLaneDispatcher(
                 self.bls_supervisor, prom=self.metrics,
             )
+            # crash-safe warm boot (ISSUE 19): load every persisted AOT
+            # executable for this build fingerprint BEFORE declaring the
+            # verifier ready — a restart against a populated store serves
+            # its dispatch ladder without entering XLA at all; a missing/
+            # corrupt store degrades to the normal JIT path (counted, not
+            # fatal)
+            from ..observability.compile_ledger import ledger as _ledger
+
+            aot = _ledger().preload_aot()
+            if aot["loaded"]:
+                self.log.info(
+                    "aot store: %d executable(s) loaded in %.1fs "
+                    "(restart without XLA in the loop)",
+                    len(aot["loaded"]), aot["seconds"],
+                )
             timeline().mark("verifier_ready")
         else:
             self.bls_supervisor = None

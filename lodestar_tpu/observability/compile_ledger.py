@@ -128,6 +128,14 @@ class CompileLedger:
         self._pipelines: list = []  # guarded-by: _lock
         self._last_prune: dict | None = None  # guarded-by: _lock
         self._entries_at_start: int | None = None  # guarded-by: _lock
+        # AOT executable overrides (ISSUE 19): (kernel, key) -> loaded
+        # `jax.stages.Compiled` deserialized from ops/aot_store — once an
+        # entry is here, dispatches bypass the jitted fn (and therefore
+        # XLA trace/compile) entirely
+        self._aot_execs: dict = {}  # guarded-by: _lock
+        self._aot_counts: dict = {}  # guarded-by: _lock
+        self._aot_events: list[dict] = []  # guarded-by: _lock
+        self._aot_marked = False  # guarded-by: _lock (aot_load phase once)
 
     # -- pipeline fan-out ---------------------------------------------------
 
@@ -158,12 +166,17 @@ class CompileLedger:
         def wrapped(*args, **kwargs):
             key = static_key if static_key is not None else _shape_key(args, kwargs)
             with self._lock:
-                fresh = (kernel, key) not in self._seen
+                # AOT override first: a loaded executable serves every
+                # call for its signature without touching the jitted fn
+                exec_ = self._aot_execs.get((kernel, key))
+                fresh = exec_ is None and (kernel, key) not in self._seen
                 if fresh:
                     # marked BEFORE the call: a concurrent second caller
                     # must not double-record, and a wedged compile must
                     # not re-record after a watchdog restart of the phase
                     self._seen.add((kernel, key))
+            if exec_ is not None:
+                return exec_(*args, **kwargs)
             if not fresh:
                 return fn(*args, **kwargs)
             return self._timed_first_call(fn, kernel, key, args, kwargs)
@@ -172,6 +185,12 @@ class CompileLedger:
         return wrapped
 
     def _timed_first_call(self, fn, kernel, key, args, kwargs):
+        # load-before-compile (ISSUE 19): a persisted AOT executable for
+        # this exact signature + build fingerprint replaces the compile;
+        # every store failure mode degrades to the normal JIT path below
+        exec_ = self._aot_attempt(kernel, key)
+        if exec_ is not None:
+            return exec_(*args, **kwargs)
         cache_dir = _cache_dir()
         self._ensure_cache_baseline(cache_dir)
         before = _cache_listing(cache_dir)
@@ -180,7 +199,7 @@ class CompileLedger:
         # post-mortem as started-but-unfinished
         flight_recorder.record("compile_start", kernel=kernel, key=key)
         t0 = time.monotonic()
-        out = fn(*args, **kwargs)
+        out = self._compile_maybe_export(fn, kernel, key, args, kwargs)
         duration_s = time.monotonic() - t0
         if cache_dir is None:
             cache = "off"
@@ -190,6 +209,151 @@ class CompileLedger:
             cache = "hit"
         self.record(kernel, key, duration_s, cache)
         return out
+
+    def _compile_maybe_export(self, fn, kernel, key, args, kwargs):
+        """The first call itself. In producer mode (LODESTAR_TPU_AOT_EXPORT)
+        a lowerable fn compiles via `lower().compile()` — one compile, the
+        same one the plain call would do — and the executable is
+        serialized into the store before dispatching. Any export failure
+        degrades to the plain call: export must never fail a dispatch."""
+        from ..ops import aot_store
+
+        st = aot_store.store() if aot_store.export_enabled() else None
+        if st is None or not hasattr(fn, "lower"):
+            return fn(*args, **kwargs)
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+        except Exception as e:
+            # e.g. a ledger-wrapped callable that isn't a jit entry after
+            # all; the plain call still compiles + serves
+            flight_recorder.record(
+                "aot_export_failed", kernel=kernel, key=key,
+                stage="lower", error=repr(e)[:200],
+            )
+            print(f"aot_store: lower/compile for export failed "
+                  f"({kernel}:{key}): {e!r}", file=sys.stderr)
+            return fn(*args, **kwargs)
+        t0 = time.monotonic()
+        try:
+            st.save(kernel, key, compiled)
+        except aot_store.AotError as e:
+            flight_recorder.record(
+                "aot_export_failed", kernel=kernel, key=key,
+                stage="save", error=str(e)[:200],
+            )
+            print(f"aot_store: export failed ({kernel}:{key}): {e}",
+                  file=sys.stderr)
+        else:
+            self.note_aot(kernel, key, "export",
+                          seconds=time.monotonic() - t0)
+        with self._lock:
+            # later calls dispatch the compiled executable directly —
+            # identical semantics, and it keeps the exported artifact an
+            # exact record of what this process served
+            self._aot_execs[(kernel, key)] = compiled
+        return compiled(*args, **kwargs)
+
+    # -- AOT store (ISSUE 19) ----------------------------------------------
+
+    def _aot_attempt(self, kernel: str, key: str):
+        """Try to serve (kernel, key) from the AOT store. Returns the
+        loaded executable (memoized into the override map) or None; every
+        failure mode is counted + flight-recorded, never raised."""
+        from ..ops import aot_store
+
+        st = aot_store.store() if aot_store.load_enabled() else None
+        if st is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            exec_ = st.load(kernel, key)
+        except aot_store.AotMiss:
+            self.note_aot(kernel, key, "miss")
+            return None
+        except aot_store.AotVersionMismatch as e:
+            self.note_aot(kernel, key, "version_mismatch", detail=str(e))
+            return None
+        except aot_store.AotError as e:
+            self.note_aot(kernel, key, "corrupt", detail=str(e))
+            return None
+        duration_s = time.monotonic() - t0
+        with self._lock:
+            self._aot_execs[(kernel, key)] = exec_
+            self._seen.add((kernel, key))
+            first = not self._aot_marked
+            self._aot_marked = True
+        if first:
+            timeline().mark("aot_load")
+        self.note_aot(kernel, key, "hit", seconds=duration_s)
+        # aot_hit rides the compile-event stream alongside hit/miss/off:
+        # the cold-start story stays in ONE place (/debug/compiles,
+        # compile_ledger.json, the compile_events metric family)
+        self.record(kernel, key, duration_s, cache="aot_hit")
+        return exec_
+
+    def preload_aot(self, kernels=None) -> dict:
+        """Eagerly load every store artifact for the CURRENT build
+        fingerprint into the override map (node restart, the cold-restart
+        test): serving-ready then means every persisted signature
+        dispatches without entering XLA. `kernels` optionally restricts
+        to a set of kernel names. Returns a summary dict; never raises."""
+        from ..ops import aot_store
+
+        st = aot_store.store() if aot_store.load_enabled() else None
+        summary: dict = {"loaded": [], "skipped": 0}
+        t_start = time.monotonic()
+        if st is None:
+            summary["seconds"] = 0.0
+            return summary
+        for entry in st.entries():
+            kernel, key = entry.get("kernel"), entry.get("key")
+            if not kernel or key is None:
+                summary["skipped"] += 1  # unreadable header: lazy path
+                continue  # will classify it if the signature is dispatched
+            if kernels is not None and kernel not in kernels:
+                summary["skipped"] += 1
+                continue
+            if entry.get("fingerprint") != st.current_fingerprint():
+                self.note_aot(kernel, key, "version_mismatch",
+                              detail="preload: foreign build")
+                summary["skipped"] += 1
+                continue
+            with self._lock:
+                already = (kernel, key) in self._aot_execs
+            if already:
+                summary["skipped"] += 1
+            elif self._aot_attempt(kernel, key) is None:
+                summary["skipped"] += 1  # outcome already counted
+            else:
+                summary["loaded"].append(f"{kernel}:{key}")
+        summary["seconds"] = round(time.monotonic() - t_start, 3)
+        return summary
+
+    def note_aot(self, kernel: str, key: str, outcome: str,
+                 seconds: float = 0.0, detail: str | None = None) -> dict:
+        """One AOT store event (hit/miss/corrupt/version_mismatch/export):
+        bounded event list, flight recorder, and the
+        `lodestar_tpu_aot_events_total` family on every live pipeline."""
+        event = {
+            "kernel": kernel,
+            "key": key,
+            "outcome": outcome,
+            "seconds": round(seconds, 4),
+        }
+        if detail:
+            event["detail"] = str(detail)[:200]
+        with self._lock:
+            self._aot_events.append(event)
+            if len(self._aot_events) > self._max_events:
+                del self._aot_events[0]
+            self._aot_counts[outcome] = self._aot_counts.get(outcome, 0) + 1
+        flight_recorder.record(
+            "aot", kernel=kernel, key=key, outcome=outcome,
+            seconds=event["seconds"],
+        )
+        for p in self.pipelines():
+            p.aot_event(kernel, outcome)
+        return event
 
     # -- recording ----------------------------------------------------------
 
@@ -263,10 +427,13 @@ class CompileLedger:
 
     def snapshot(self) -> dict:
         """The `/debug/compiles` + bench-section document."""
+        from ..ops import aot_store
+
         cache_dir = _cache_dir()
         self._ensure_cache_baseline(cache_dir)
         device = _device_key()
         entries_now = len(_cache_listing(cache_dir)) if cache_dir else None
+        aot_dir = aot_store.store_dir()
         with self._lock:
             events = list(self._events)
             doc = {
@@ -280,6 +447,15 @@ class CompileLedger:
                     "hits": self._counts.get("hit", 0),
                     "misses": self._counts.get("miss", 0),
                     "uncached": self._counts.get("off", 0),
+                    "aot_hits": self._counts.get("aot_hit", 0),
+                },
+                "aot": {
+                    "store": aot_dir,
+                    "load": aot_store.load_enabled(),
+                    "export": aot_store.export_enabled(),
+                    "loaded_executables": len(self._aot_execs),
+                    "counts": dict(self._aot_counts),
+                    "events": list(self._aot_events),
                 },
                 "events": events,
             }

@@ -344,6 +344,14 @@ class PipelineMetrics:
             "lodestar_tpu_compile_cache_pruned_bytes_total",
             "bytes the LRU pruner removed from the persistent compile cache",
         )
+        self.aot_events = r.counter(
+            "lodestar_tpu_aot_events_total",
+            "AOT executable-store events by kernel and outcome (hit = "
+            "executable loaded from disk instead of compiling, miss = no "
+            "artifact, corrupt / version_mismatch = artifact rejected and "
+            "degraded to JIT, export = artifact written by the producer)",
+            label_names=("kernel", "outcome"),
+        )
         self.serving_ready_gauge = r.gauge(
             "lodestar_tpu_serving_ready_seconds",
             "seconds from process start to serving-ready (cold-start SLO; "
@@ -613,6 +621,11 @@ class PipelineMetrics:
         self.compile_seconds.inc(seconds, kernel=kernel)
         if cumulative_s is not None:
             self.compile_cumulative.set(cumulative_s)
+
+    def aot_event(self, kernel: str, outcome: str) -> None:
+        """One AOT-store event observed by the compile ledger (the ledger
+        fans this out to every live pipeline — don't call directly)."""
+        self.aot_events.inc(kernel=kernel, outcome=outcome)
 
     def cache_pruned(self, removed_bytes: int, entries_remaining: int) -> None:
         """One compile-cache prune pass (tools/prune_compile_cache.py)."""

@@ -81,21 +81,33 @@ def _default_factory(kind: str, devices, axis: str):
 
 
 def _ledger_wrap_submit(v, kind: str, shape, chips) -> None:
-    """Route a freshly built sharded verifier's first `submit` through the
-    compile ledger: each (kind, shape, chip-set) verifier is exactly one
-    shard_map compile, so the static key encodes shape+chips — a
-    post-eviction mesh shrink recompiling on the serving path records a
-    NEW event (the ROADMAP item-5 restart-story cost, now measured).
-    Factory products without a rebindable `submit` (test stubs with
-    __slots__/properties) are left untouched."""
+    """Route a freshly built sharded verifier through the compile ledger:
+    each (kind, shape, chip-set) verifier is exactly one shard_map
+    compile, so the static key encodes shape+chips — a post-eviction mesh
+    shrink recompiling on the serving path records a NEW event (the
+    ROADMAP item-5 restart-story cost, now measured).
+
+    The seam prefers the verifier's jitted `_run` over the `submit`
+    facade: `_run` is the actual jit entry (it has `.lower`), which is
+    what the ledger's AOT store needs to export a serialized executable —
+    and what lets an evicted-mesh re-dispatch for an already-exported
+    shrunk chip set load machine code from disk instead of entering XLA
+    (ISSUE 19). Factory products without a rebindable `_run`/`submit`
+    (test stubs with __slots__/properties) fall back or are left
+    untouched."""
     from ..observability.compile_ledger import ledger
 
+    kernel = f"sharded_{kind}"
+    static_key = f"{tuple(shape)}@chips{','.join(str(c) for c in chips)}"
+    if getattr(v, "_run", None) is not None:
+        try:
+            v._run = ledger().wrap(v._run, kernel, static_key=static_key)
+            return
+        except AttributeError:
+            logger.debug("mesh: %s verifier _run not rebindable; trying "
+                         "submit", kind)
     try:
-        v.submit = ledger().wrap(
-            v.submit,
-            f"sharded_{kind}",
-            static_key=f"{tuple(shape)}@chips{','.join(str(c) for c in chips)}",
-        )
+        v.submit = ledger().wrap(v.submit, kernel, static_key=static_key)
     except AttributeError:
         logger.debug("mesh: %s verifier submit not rebindable; compile "
                      "ledger seam skipped", kind)

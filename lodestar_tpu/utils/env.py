@@ -201,8 +201,21 @@ _register("LODESTAR_TPU_COMPILE_CACHE", "str", None,
           "Persistent XLA compile-cache dir; 0/off/none disables "
           "persistence; unset = repo-local .jax_cache.")
 _register("LODESTAR_TPU_CACHE_LIMIT_GB", "float", 2.0,
-          "LRU bound for the persistent compile cache "
+          "Shared LRU byte bound across the persistent compile cache "
+          "AND the AOT executable store "
           "(tools/prune_compile_cache.py).")
+_register("LODESTAR_TPU_AOT_STORE", "str", None,
+          "Directory of serialized AOT-compiled executables "
+          "(ops/aot_store.py); 0/off/none disables the store entirely; "
+          "unset = repo-local .aot_store.")
+_register("LODESTAR_TPU_AOT_LOAD", "bool", True,
+          "Load persisted AOT executables before compiling (restart "
+          "without XLA in the loop); off forces normal JIT even with a "
+          "populated store.")
+_register("LODESTAR_TPU_AOT_EXPORT", "bool", False,
+          "Producer mode: first-dispatch compiles go through "
+          "lower().compile() and the executable is serialized into the "
+          "AOT store (tools/warmup.py --aot-export sets this).")
 
 # --- bench / tools / tests ------------------------------------------------
 _register("LODESTAR_TPU_BENCH_PHASE_DEADLINE", "float", 600.0,

@@ -29,6 +29,17 @@ _cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
+# AOT executable store (ISSUE 19): hermetic per-run directory unless the
+# caller pins one — the suite must neither load a developer's repo-local
+# .aot_store (stale executables would mask compile-path regressions) nor
+# have tiny-budget prune tests delete its artifacts.
+if "LODESTAR_TPU_AOT_STORE" not in os.environ:
+    import tempfile
+
+    os.environ["LODESTAR_TPU_AOT_STORE"] = tempfile.mkdtemp(
+        prefix="lodestar_aot_test_"
+    )
+
 import pytest  # noqa: E402
 
 

@@ -90,10 +90,11 @@ def test_prune_dry_run_deletes_nothing(cache):
 
 
 def test_prune_missing_dir_is_noop(tmp_path):
-    r = pcc.prune(str(tmp_path / "nope"), limit_gb=0.0)
+    r = pcc.prune(str(tmp_path / "nope"), limit_gb=0.0, aot_dir=None)
     assert r == {
         "entries": 0, "entries_remaining": 0, "total_bytes": 0,
         "limit_bytes": 0, "removed": [], "removed_bytes": 0,
+        "dirs": [str(tmp_path / "nope")], "aot_removed": 0,
     }
 
 

@@ -90,6 +90,10 @@ REQUIRED_GATED_KEYS = (
     # ISSUE 18: the epoch-warm attestation-lane host-marshal rate (the
     # epoch table + H(msg) dedup win; parity-gated in its phase)
     "attestation_epoch_warm_sets_per_sec",
+    # ISSUE 19: the cold-start SLO as a gated time row (direction: down —
+    # a round whose serving-ready grew 3x regressed the restart story,
+    # e.g. a broken AOT store silently degrading every boot to JIT)
+    "serving_ready_seconds",
 )
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")

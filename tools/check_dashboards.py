@@ -74,6 +74,10 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_tpu_compile_cumulative_seconds",
         "lodestar_tpu_compile_cache_entries",
         "lodestar_tpu_compile_cache_pruned_bytes_total",
+        # AOT executable store (ISSUE 19): a store silently degrading
+        # every restart to JIT (corrupt artifacts, fingerprint drift
+        # after an upgrade) must be a dashboard signal, not a log line
+        "lodestar_tpu_aot_events_total",
         # epoch-resident crypto families (ISSUE 18): the device pubkey
         # table's hit rate / occupancy / rotation and the dispatcher's
         # H(msg) dedup — a table that silently stopped serving (0% hits

@@ -11,6 +11,10 @@ makes warm-up an explicit, documented step:
                                          #   CPU-mesh shape (run after the
                                          #   LAST kernel change of a round)
   python tools/warmup.py --prune-gb 6    # GC the cache down to 6 GiB (LRU)
+  python tools/warmup.py --aot-export    # producer mode: additionally
+                                         #   serialize every compiled
+                                         #   executable into the AOT store
+                                         #   (restart without XLA — ISSUE 19)
 
 Every warm-up pass ends with an automatic LRU GC of the cache (bound:
 LODESTAR_TPU_CACHE_LIMIT_GB, default 2 GiB) — the policy lives in
@@ -275,9 +279,15 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
     # the ladder is the serving contract: every production shape compiled
     # means a node restarting against this cache is serving-ready here
     t_ready = timeline().mark_serving_ready()
+    snap = ledger().snapshot()
     print(f"warmup: serving-ready at {t_ready:.1f}s since process start "
-          f"({ledger().snapshot()['cumulative_seconds']:.1f}s in compiles)",
+          f"({snap['cumulative_seconds']:.1f}s in compiles)",
           flush=True)
+    aot = snap.get("aot") or {}
+    if aot.get("store") and (aot.get("counts") or aot.get("export")):
+        print(f"warmup: aot store {aot['store']}: {aot.get('counts', {})} "
+              f"({aot.get('loaded_executables', 0)} executable(s) "
+              f"in memory)", flush=True)
     ledger().write_artifact(os.path.join(CACHE_DIR, "..",
                                          "compile_ledger.json"))
 
@@ -307,9 +317,19 @@ def main() -> None:
     ap.add_argument("--no-device-decompress", action="store_true",
                     help="skip the *_raw kernels (for hosts pinning the "
                          "C-tier marshal via LODESTAR_TPU_DEVICE_DECOMPRESS=0)")
+    ap.add_argument("--aot-export", action="store_true",
+                    help="producer mode for the AOT executable store "
+                         "(ops/aot_store.py): every ladder compile is "
+                         "serialized to LODESTAR_TPU_AOT_STORE so a node "
+                         "restart loads machine code instead of entering "
+                         "XLA (sets LODESTAR_TPU_AOT_EXPORT=1)")
     ap.add_argument("--prune-gb", type=float, default=None,
                     help="GC the cache to this many GiB (LRU) and exit")
     args = ap.parse_args()
+    if args.aot_export:
+        # before any jax/ledger work: export_enabled() is read at each
+        # kernel's first dispatch
+        os.environ["LODESTAR_TPU_AOT_EXPORT"] = "1"
     if args.prune_gb is not None:
         prune_cache(args.prune_gb)
         return
