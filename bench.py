@@ -641,6 +641,80 @@ def _bench_sharded_grouped(jax, pipeline) -> dict | None:
     }
 
 
+def _bench_fleet_dryrun(jax, pipeline) -> dict | None:
+    """Two-level fleet serving dryrun (ISSUE 20): the SAME grouped batch
+    through the flat single-host mesh AND an emulated 2-host (dcn, ici)
+    two-level mesh over the identical device set. Parity gates first —
+    valid and tampered verdict bytes must be identical between the two
+    layouts (`fleet_parity_ok`, gated by tools/bench_compare.py) — then
+    the retained-throughput fraction `fleet_overlap_fraction` =
+    t_flat / t_two_level: the cost of routing the one Fp12 partial and
+    the 64 combined plane sums per host across the DCN axis instead of
+    keeping every collective on ICI. 1.0 = the two-level layout serves
+    at flat-mesh speed (perfect overlap); the fleet-math section of
+    BASELINE.md scales host count by this fraction."""
+    import numpy as np
+
+    from lodestar_tpu.parallel.fleet import FleetRouter
+    from lodestar_tpu.parallel.mesh import NOT_SHARDED, BlsMeshDispatcher
+    from lodestar_tpu.parallel.sharded import mesh_divisor
+
+    devices = jax.devices()
+    n = mesh_divisor(len(devices))
+    if n < 4:
+        return None  # an emulated 2-host fleet needs >=2 chips per host
+    rows, lanes = 8 * n, 64
+    g, a_bits, b_bits = _example_grouped(rows, lanes)
+    flat = BlsMeshDispatcher(devices[:n], observer=pipeline)
+    half = n // 2
+    fleet = BlsMeshDispatcher(
+        devices[:n],
+        observer=pipeline,
+        hosts=[list(range(half)), list(range(half, n))],
+        router=FleetRouter(2, 0, observer=pipeline),
+    )
+
+    def run(d):
+        r = d.dispatch_grouped(g, a_bits, b_bits)
+        assert r is not NOT_SHARDED, "fleet dryrun batch refused"
+        return r
+
+    v_flat, v_fleet = run(flat), run(fleet)
+    parity = (
+        np.asarray(v_flat).tobytes() == np.asarray(v_fleet).tobytes()
+        and bool(v_flat)
+    )
+    g.sig_x[0, 0, 0, 0] ^= 1  # tampered: both layouts must reject
+    vb_flat, vb_fleet = run(flat), run(fleet)
+    parity = (
+        parity
+        and np.asarray(vb_flat).tobytes() == np.asarray(vb_fleet).tobytes()
+        and not bool(vb_flat)
+    )
+    g.sig_x[0, 0, 0, 0] ^= 1
+
+    def time_reps(d) -> float:
+        r = None
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            r = run(d)
+        bool(r)
+        return (time.perf_counter() - t0) / REPS
+
+    t_flat, t_fleet = time_reps(flat), time_reps(fleet)
+    snap = fleet.fleet_snapshot() or {}
+    return {
+        "fleet_parity_ok": int(parity),
+        "fleet_overlap_fraction": (
+            round(t_flat / t_fleet, 4) if t_fleet > 0 else 0.0
+        ),
+        "fleet_sets_per_sec": round(rows * lanes / t_fleet, 2),
+        "fleet_hosts": fleet.hosts_serving,
+        "fleet_chips_per_host": half,
+        "fleet_host_dispatches": snap.get("host_dispatches", {}),
+    }
+
+
 def _bench_e2e_mesh_raw(jax, pipeline, headline_rate) -> dict | None:
     """Wire-bytes → verdict through the MESH raw path (ISSUE 15 tentpole):
     the no-flags default facade with a mesh attached — host marshal is a
@@ -891,6 +965,9 @@ def main() -> None:
     # lane dispatcher state (ISSUE 15): queue depths / sheds / coalescing
     # — the flood phase drives these; None until a dispatcher binds
     em.add_section("lanes", pipeline.lanes_snapshot)
+    # fleet counters (ISSUE 20): host census / evictions / rebalances /
+    # DCN collective seconds — the fleet_dryrun phase drives these
+    em.add_section("fleet", pipeline.fleet_snapshot)
     # compile accounting + cold-start timeline: which kernels compiled
     # this run, cache hit/miss, cumulative compile seconds, and the
     # process-start→serving-ready phase marks
@@ -1048,6 +1125,19 @@ def main() -> None:
                 "bench: sharded grouped "
                 f"{sharded_rows['sharded_grouped_sets_per_sec']:.1f} sets/s "
                 f"on {sharded_rows['mesh_devices']} device(s)"
+            )
+
+    _log("bench: fleet-dryrun phase...")
+    with em.phase("fleet_dryrun", deadline_s=deadline) as ph:
+        fleet_rows = _bench_fleet_dryrun(jax, pipeline)
+        if fleet_rows is not None:
+            ph.update(fleet_rows)
+            _log(
+                "bench: fleet dryrun parity_ok="
+                f"{fleet_rows['fleet_parity_ok']} overlap="
+                f"{fleet_rows['fleet_overlap_fraction']:.3f} "
+                f"({fleet_rows['fleet_sets_per_sec']:.1f} sets/s on "
+                f"{fleet_rows['fleet_hosts']} emulated host(s))"
             )
 
     _log("bench: e2e mesh-raw phase...")

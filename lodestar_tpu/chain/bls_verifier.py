@@ -172,6 +172,16 @@ class DeviceBlsVerifier:
     def mesh_snapshot(self):
         return self._inner.mesh_snapshot()
 
+    def mesh_evict_host(self, host: int | None = None,
+                        reason: str = "failure"):
+        return self._inner.mesh_evict_host(host=host, reason=reason)
+
+    def fleet_snapshot(self):
+        return self._inner.fleet_snapshot()
+
+    def fleet_attach_router(self, router) -> None:
+        self._inner.fleet_attach_router(router)
+
     # -- epoch-resident crypto passthroughs (ISSUE 18) ----------------------
 
     def warm_h2c(self, messages) -> int:

@@ -493,6 +493,41 @@ class SupervisedBlsVerifier:
 
     # -- dispatch --------------------------------------------------------------
 
+    def _evict_sick_host(self, exc, n_sets: int, reason: str) -> bool:
+        """Fleet half of the failure policy (ISSUE 20): when a dispatch
+        failure attributes a whole HOST (`exc.host`, e.g.
+        testing.faults.InjectedHostFault), evict that host from the
+        two-level serving mesh and retry on the survivors — the
+        chip-eviction ladder one level up. Like chip eviction, a host
+        eviction consumes NO transient-retry budget and does NOT feed
+        the breaker: a fleet serving correctly on fewer hosts is
+        healthy, just smaller (and the FleetRouter has already
+        rebalanced the evicted host's subnets). Returns True when a
+        host was evicted (caller should retry)."""
+        host = getattr(exc, "host", None)
+        if host is None:
+            return False
+        evict = getattr(self.device, "mesh_evict_host", None)
+        if evict is None:
+            return False
+        try:
+            new_size = evict(host=host, reason=reason)
+        except Exception:  # pragma: no cover — eviction must never mask
+            return False
+        if new_size is None:
+            return False
+        self._maybe_span_event(
+            "bls/fleet_host_eviction", reason=reason, new_size=new_size
+        )
+        self._rl.warning(
+            "fleet_evict",
+            "fleet host evicted (%s); retrying %d sets on the surviving "
+            "%d-chip mesh", reason, n_sets, max(new_size, 1),
+        )
+        if self._canary_thread_enabled:
+            self._start_canary_thread()
+        return True
+
     def _evict_sick_chip(self, exc, n_sets: int, reason: str) -> bool:
         """Mesh half of the failure policy (round-7 tentpole): when the
         device tier serves from a chip mesh, a failed dispatch evicts the
@@ -550,6 +585,8 @@ class SupervisedBlsVerifier:
                     continue
                 raise
             except Exception as e:
+                if self._evict_sick_host(e, n_sets, type(e).__name__):
+                    continue
                 if self._evict_sick_chip(e, n_sets, type(e).__name__):
                     continue
                 attempt += 1

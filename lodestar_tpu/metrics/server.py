@@ -12,6 +12,11 @@ profiler + lifecycle-trace control surface:
                           chips and compiled sharded verifiers
                           (parallel/mesh.py); unmeshed nodes report
                           wired: false
+    GET /debug/fleet      fleet-serving census: two-level host layout,
+                          per-host dispatches, evicted hosts and the
+                          subnet router's slice/rebalance state
+                          (parallel/mesh.py + parallel/fleet.py);
+                          single-host nodes report wired: false
     GET /debug/lanes      priority-lane dispatcher state: per-lane queue
                           depth/caps, shed counts, coalesced batches and
                           the double-buffer overlap fraction
@@ -69,6 +74,7 @@ class MetricsServer:
         tracer=None,
         breaker=None,
         mesh=None,
+        fleet=None,
         lanes=None,
         slo=None,
         device=None,
@@ -170,6 +176,22 @@ class MetricsServer:
                     if mesh is not None:
                         try:
                             snap = mesh()
+                        except Exception as e:
+                            self._send_json(500, {"error": str(e)})
+                            return
+                    if snap is None:
+                        self._send_json(200, {"wired": False})
+                        return
+                    self._send_json(200, {"wired": True, **snap})
+                    return
+                if route == "/debug/fleet":
+                    # fleet = zero-arg callable returning the dispatcher's
+                    # fleet_snapshot(); single-host or unmeshed nodes
+                    # report wired: false (no DCN axis, no subnet router)
+                    snap = None
+                    if fleet is not None:
+                        try:
+                            snap = fleet()
                         except Exception as e:
                             self._send_json(500, {"error": str(e)})
                             return

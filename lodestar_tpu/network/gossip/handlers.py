@@ -52,11 +52,16 @@ _ACTION_TO_RESULT = {
 class GossipHandlers:
     """Owns the validation queues and the per-type handler logic."""
 
-    def __init__(self, config, types, chain, verify_signatures: bool = True):
+    def __init__(self, config, types, chain, verify_signatures: bool = True,
+                 fleet_router=None):
         self.config = config
         self.types = types
         self.chain = chain
         self.verify_signatures = verify_signatures
+        # subnet → host routing for fleet ingest (parallel/fleet.py);
+        # None = single-host node, validate every subnet. Node wiring may
+        # also bind this post-construction (node.attach_network).
+        self.fleet_router = fleet_router
         self.queues: dict[GossipType, JobItemQueue] = {}
         for gtype in GossipType:
             qt, max_len, conc = QUEUE_OPTS.get(gtype, DEFAULT_QUEUE)
@@ -154,6 +159,22 @@ class GossipHandlers:
             return _ACTION_TO_RESULT[result.action]
 
         if t is GossipType.beacon_attestation:
+            # subnet-sharded fleet ingest (ISSUE 20): when a FleetRouter
+            # is bound, this host only validates (and BLS-verifies) the
+            # attestation subnets it owns — foreign-slice traffic is
+            # IGNOREd before the validation ladder, so the lane
+            # dispatcher sees exactly this host's share of the fleet
+            # load. IGNORE (not REJECT): the attestation is not invalid,
+            # it is simply another host's work.
+            router = self.fleet_router
+            if router is not None and topic.subnet is not None:
+                try:
+                    foreign = not router.owns(int(topic.subnet))
+                except Exception:  # noqa: BLE001 — routing must not drop valid work
+                    foreign = False
+                if foreign:
+                    router.record_foreign(int(topic.subnet))
+                    return ValidationResult.IGNORE
             att = types.Attestation.deserialize(ssz)
             with _spans.tracer.span(
                 "validation/attestation", slot=int(att.data.slot)

@@ -48,6 +48,7 @@ class Network:
         verify_signatures: bool = True,
         subscribe_all_subnets: bool = False,
         metrics=None,
+        fleet_router=None,
     ):
         self.metrics = metrics
         self.config = config
@@ -64,7 +65,8 @@ class Network:
         self.gossip.metrics = metrics
         self.gossip_service = GossipsubService(self.transport, self.gossip)
         self.gossip_handlers = GossipHandlers(
-            config, types, chain, verify_signatures=verify_signatures
+            config, types, chain, verify_signatures=verify_signatures,
+            fleet_router=fleet_router,
         )
         self.gossip_handlers.register(self.gossip)
         self._score_params = score_params

@@ -153,6 +153,34 @@ class BeaconNode:
         else:
             self.bls_supervisor = None
             verifier = CpuBlsVerifier()
+        # fleet ingest routing (ISSUE 20): when LODESTAR_TPU_FLEET is
+        # active this host validates only its subnet slice of attestation
+        # gossip, and a supervisor host-eviction rebalances the slice map
+        # onto the survivors (parallel/fleet.py; wired into the gossip
+        # handlers at attach_network)
+        from ..parallel.fleet import FleetRouter, FleetTopology
+
+        fleet_topo = FleetTopology.from_env()
+        self.fleet_router = None
+        if fleet_topo.active:
+            self.fleet_router = FleetRouter(
+                fleet_topo.hosts, fleet_topo.rank,
+                observer=self.metrics.pipeline,
+            )
+            if self.bls_supervisor is not None:
+                try:
+                    self.bls_supervisor.fleet_attach_router(
+                        self.fleet_router
+                    )
+                except Exception:  # noqa: BLE001 — wiring must not kill init
+                    self.log.debug(
+                        "fleet router mesh attach failed", exc_info=True
+                    )
+            self.log.info(
+                "fleet ingest: rank %d/%d owns %d attestation subnet(s)",
+                fleet_topo.rank, fleet_topo.hosts,
+                len(self.fleet_router.slice_for()),
+            )
         self.chain = BeaconChain(
             config,
             types,
@@ -213,6 +241,7 @@ class BeaconNode:
                     if self.bls_supervisor is not None
                     else None
                 ),
+                fleet=self._fleet_debug_snapshot,
                 lanes=self.metrics.pipeline.lanes_snapshot,
                 slo=slo.snapshot_or_none,
                 device=device_ledger.ledger().snapshot,
@@ -242,10 +271,34 @@ class BeaconNode:
 
     def attach_network(self, network) -> None:
         """Bind a started Network: REST node-identity/peers routes and the
-        sync layer see it (reference nodejs.ts wiring order §3.1)."""
+        sync layer see it (reference nodejs.ts wiring order §3.1). A
+        fleet node also binds its subnet router into the gossip handlers
+        so foreign-slice attestations are dropped pre-validation."""
         self.network = network
         if self.api_server is not None:
             self.api_server.impl.network = network
+        handlers = getattr(network, "gossip_handlers", None)
+        if self.fleet_router is not None and handlers is not None:
+            from ..utils.env import env_bool
+
+            if env_bool("LODESTAR_TPU_FLEET_INGEST"):
+                handlers.fleet_router = self.fleet_router
+
+    def _fleet_debug_snapshot(self):
+        """Zero-arg provider for `/debug/fleet`: the two-level mesh census
+        (with the router's slice state) when the device tier serves a
+        fleet, else the bare router view, else None (wired: false)."""
+        snap = None
+        if self.bls_supervisor is not None:
+            try:
+                snap = self.bls_supervisor.fleet_snapshot()
+            except Exception:  # noqa: BLE001 — debug surface must not raise
+                snap = None
+        if snap is None and self.fleet_router is not None:
+            snap = {"router": self.fleet_router.snapshot()}
+        if snap is not None:
+            snap["counters"] = self.metrics.pipeline.fleet_snapshot()
+        return snap
 
     # -- slot driving --------------------------------------------------------
 

@@ -264,6 +264,42 @@ class PipelineMetrics:
             "sharded kernel dispatches per participating chip",
             label_names=("chip",),
         )
+        # fleet serving (ISSUE 20): the mesh abstracted over HOSTS — a
+        # two-level (DCN × ICI) dispatch layout plus subnet-sharded
+        # gossip routing; these families tell a full fleet from one
+        # serving degraded after a host eviction
+        self.fleet_hosts = r.gauge(
+            "lodestar_bls_fleet_hosts",
+            "hosts in the two-level serving fleet "
+            "(0/1 = single-host, no DCN axis)",
+        )
+        self.fleet_evicted_hosts = r.gauge(
+            "lodestar_bls_fleet_evicted_hosts",
+            "hosts currently evicted from the serving fleet",
+        )
+        self.fleet_host_dispatches = r.counter(
+            "lodestar_bls_fleet_host_dispatch_total",
+            "two-level sharded dispatches per participating host",
+            label_names=("host",),
+        )
+        self.fleet_dcn = r.counter(
+            "lodestar_bls_fleet_dcn_collective_seconds_total",
+            "wall seconds spent in DCN-spanning (multi-host) dispatches "
+            "— an upper bound on cross-host collective cost",
+        )
+        self.fleet_host_evictions = r.counter(
+            "lodestar_bls_fleet_host_evictions_total",
+            "hosts evicted from the serving fleet, by failure reason",
+            label_names=("reason",),
+        )
+        self.fleet_rebalances = r.counter(
+            "lodestar_bls_fleet_rebalances_total",
+            "subnet-routing rebalances after host eviction/re-admission",
+        )
+        self.fleet_subnets_moved = r.counter(
+            "lodestar_bls_fleet_subnets_moved_total",
+            "attestation subnets re-homed across hosts by rebalances",
+        )
         # priority-lane dispatcher (round 15): continuous batching with
         # admission control — depth per lane, sheds per lane, coalesced
         # batch size, and the double-buffer overlap fraction (how often a
@@ -559,6 +595,33 @@ class PipelineMetrics:
         for chip in chips:
             self.mesh_dispatches.inc(chip=str(chip))
 
+    # -- fleet serving ------------------------------------------------------
+
+    def fleet_state(self, hosts: int, evicted: int) -> None:
+        """Assert the current fleet shape (serving + evicted host gauges)."""
+        self.fleet_hosts.set(hosts)
+        self.fleet_evicted_hosts.set(evicted)
+
+    def fleet_dispatch(self, hosts) -> None:
+        """Tick the per-host dispatch counter for every participating host
+        of one two-level (DCN-spanning) dispatch."""
+        for host in hosts:
+            self.fleet_host_dispatches.inc(host=str(host))
+
+    def fleet_dcn_seconds(self, seconds: float) -> None:
+        self.fleet_dcn.inc(max(seconds, 0.0))
+
+    def fleet_host_eviction(self, host: int, reason: str) -> None:
+        self.fleet_host_evictions.inc(reason=reason)
+        flight_recorder.record("fleet_host_eviction", host=host,
+                               reason=reason)
+
+    def fleet_rebalance(self, subnets_moved: int) -> None:
+        self.fleet_rebalances.inc()
+        if subnets_moved:
+            self.fleet_subnets_moved.inc(subnets_moved)
+        flight_recorder.record("fleet_rebalance", subnets=subnets_moved)
+
     # -- priority-lane dispatcher -------------------------------------------
 
     def bind_lane_depths(self, fn) -> None:
@@ -769,6 +832,28 @@ class PipelineMetrics:
             "evictions": evictions,
             "readmissions": int(self.mesh_readmissions.value()),
             "chip_dispatches": dispatches,
+        }
+
+    def fleet_snapshot(self) -> dict:
+        """Fleet-serving counters for the bench document and
+        `/debug/fleet`: host gauges, per-host dispatches, DCN seconds and
+        the eviction/rebalance history."""
+        evictions = {
+            labels.get("reason", ""): int(v)
+            for labels, v in self.fleet_host_evictions.collect()
+        }
+        dispatches = {
+            labels.get("host", ""): int(v)
+            for labels, v in self.fleet_host_dispatches.collect()
+        }
+        return {
+            "hosts": int(self.fleet_hosts.value()),
+            "evicted_hosts": int(self.fleet_evicted_hosts.value()),
+            "host_evictions": evictions,
+            "host_dispatches": dispatches,
+            "dcn_collective_seconds": round(self.fleet_dcn.value(), 6),
+            "rebalances": int(self.fleet_rebalances.value()),
+            "subnets_moved": int(self.fleet_subnets_moved.value()),
         }
 
     def supervisor_snapshot(self) -> dict:

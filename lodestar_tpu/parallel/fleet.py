@@ -1,0 +1,278 @@
+"""Fleet topology + subnet routing: the host-level (DCN) policy tier.
+
+`parallel/mesh.py` abstracts the chips of ONE host; this module is the
+layer above it — the policy that lets a serving fleet of hosts act as
+one logical verifier (ROADMAP item 5, the 200k sets/s aggregate
+target):
+
+- `FleetTopology` reads the ``LODESTAR_TPU_FLEET*`` knobs and answers
+  "how many hosts, which rank am I, and how do the visible jax devices
+  group into hosts". Two modes: a real multi-process fleet (the knob
+  names a `jax.distributed` coordinator, devices group by
+  `process_index`) and single-process emulation (local devices split
+  into N virtual hosts — the CPU-dryrun/parity mode, exactly how the
+  virtual-chip mesh already stands in for real ICI).
+- `FleetRouter` owns the subnet → host-rank assignment for attestation
+  gossip: rendezvous (highest-random-weight) hashing over the active
+  host set, so each host's `BlsLaneDispatcher` lanes only ever see its
+  slice of the `ATTESTATION_SUBNET_COUNT` subnets. HRW is what makes
+  host eviction cheap: when the supervisor evicts a whole host, ONLY
+  the evicted host's subnets move (each re-hashes to its next-best
+  survivor) — the other hosts' slices are untouched, mirroring how
+  chip eviction keeps the serving prefix stable.
+
+Both classes are jax-free and import-light on purpose: unit tests drive
+eviction/rebalance/coverage with plain integers, and the mesh module
+keeps its "no jax at import" contract when it imports this one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass
+
+from ..params.constants import ATTESTATION_SUBNET_COUNT
+from ..utils.logger import get_logger
+
+logger = get_logger("parallel.fleet")
+
+__all__ = ["FleetTopology", "FleetRouter"]
+
+_distributed_initialized = False
+
+
+@dataclass(frozen=True)
+class FleetTopology:
+    """Resolved ``LODESTAR_TPU_FLEET*`` configuration.
+
+    mode:        "off" | "emulate" | "distributed"
+    coordinator: "host:port" of the jax.distributed coordinator
+                 (distributed mode only)
+    hosts:       fleet host count (process count / virtual-host count)
+    rank:        this process's host rank in [0, hosts)
+    """
+
+    mode: str = "off"
+    coordinator: str | None = None
+    hosts: int = 1
+    rank: int = 0
+
+    @property
+    def active(self) -> bool:
+        return self.mode != "off" and self.hosts > 1
+
+    @classmethod
+    def from_env(cls) -> "FleetTopology":
+        """Parse the fleet knobs. ``LODESTAR_TPU_FLEET`` selects the
+        mode: unset/empty/off = no fleet; a value containing ``:`` names
+        the jax.distributed coordinator (real multi-process fleet);
+        anything else (``emulate``, ``1``, ``on``…) requests
+        single-process emulation over the local devices. Never raises —
+        a malformed knob degrades to "off" (the verifier must construct
+        regardless)."""
+        from ..utils.env import env_int, env_str
+
+        spec = (env_str("LODESTAR_TPU_FLEET") or "").strip()
+        if not spec or spec.lower() in ("0", "off", "false", "none"):
+            return cls()
+        hosts = max(int(env_int("LODESTAR_TPU_FLEET_HOSTS") or 2), 1)
+        rank = int(env_int("LODESTAR_TPU_FLEET_RANK") or 0)
+        if not 0 <= rank < hosts:
+            logger.warning(
+                "fleet: rank %d outside [0, %d); fleet disabled", rank, hosts
+            )
+            return cls()
+        if ":" in spec:
+            return cls(
+                mode="distributed", coordinator=spec, hosts=hosts, rank=rank
+            )
+        return cls(mode="emulate", coordinator=None, hosts=hosts, rank=rank)
+
+    def ensure_initialized(self) -> bool:
+        """Bring up `jax.distributed` for a real multi-process fleet
+        (idempotent; emulation needs no runtime). Returns True when the
+        distributed runtime is (already) up, False on failure — callers
+        degrade to single-host serving rather than raising."""
+        global _distributed_initialized
+        if self.mode != "distributed":
+            return True
+        if _distributed_initialized:
+            return True
+        try:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator,
+                num_processes=self.hosts,
+                process_id=self.rank,
+            )
+            _distributed_initialized = True
+            logger.info(
+                "fleet: jax.distributed up (coordinator %s, rank %d/%d)",
+                self.coordinator, self.rank, self.hosts,
+            )
+            return True
+        except Exception as e:  # pragma: no cover - env-dependent
+            logger.warning(
+                "fleet: jax.distributed.initialize failed (%s); serving "
+                "single-host", e,
+            )
+            return False
+
+    def group_devices(self, devices) -> list[list[int]] | None:
+        """Group the visible device list into per-host rows of device
+        INDICES (the mesh dispatcher's census format). Distributed mode
+        groups by `process_index`; emulation splits the local devices
+        into `hosts` equal contiguous rows. Returns None when no usable
+        multi-host grouping exists (callers serve single-level)."""
+        if not self.active:
+            return None
+        if self.mode == "distributed":
+            by_proc: dict[int, list[int]] = {}
+            for i, d in enumerate(devices):
+                by_proc.setdefault(int(getattr(d, "process_index", 0)), []).append(i)
+            rows = [by_proc[p] for p in sorted(by_proc)]
+        else:
+            per = len(devices) // self.hosts
+            if per < 1:
+                return None
+            rows = [
+                list(range(h * per, (h + 1) * per)) for h in range(self.hosts)
+            ]
+        return rows if len(rows) > 1 else None
+
+
+class FleetRouter:
+    """Subnet → host-rank assignment via rendezvous (HRW) hashing.
+
+    Every host computes the same deterministic owner for every subnet
+    (sha256 of ``subnet:host``, highest weight wins over the ACTIVE host
+    set), so the fleet needs no coordination traffic to agree on the
+    partition: slices are disjoint and cover all subnets by
+    construction. Thread-safe — the supervisor's eviction path and the
+    gossip validator threads race on the active set."""
+
+    def __init__(self, hosts: int, rank: int = 0,
+                 subnet_count: int = ATTESTATION_SUBNET_COUNT,
+                 observer=None):
+        if hosts < 1:
+            raise ValueError(f"fleet needs >= 1 host, got {hosts}")
+        if not 0 <= rank < hosts:
+            raise ValueError(f"rank {rank} outside [0, {hosts})")
+        self.hosts = hosts
+        self.rank = rank
+        self.subnet_count = subnet_count
+        self.observer = observer
+        self._lock = threading.Lock()
+        self._evicted: list[int] = []
+        self._rebalances = 0
+        self._subnets_moved = 0
+        self._foreign_dropped = 0
+
+    # -- assignment ---------------------------------------------------------
+
+    @staticmethod
+    def _weight(subnet: int, host: int) -> int:
+        digest = hashlib.sha256(
+            b"lodestar-fleet-subnet:%d:host:%d" % (subnet, host)
+        ).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def active_hosts(self) -> list[int]:
+        with self._lock:
+            return [h for h in range(self.hosts) if h not in self._evicted]
+
+    def owner(self, subnet: int) -> int:
+        """The host rank that owns `subnet` under the current active set."""
+        active = self.active_hosts()
+        if not active:
+            raise RuntimeError("fleet router has no active hosts")
+        return max(active, key=lambda h: self._weight(subnet, h))
+
+    def owns(self, subnet: int) -> bool:
+        return self.owner(subnet) == self.rank
+
+    def slice_for(self, rank: int | None = None) -> tuple[int, ...]:
+        """Every subnet owned by `rank` (default: this host)."""
+        r = self.rank if rank is None else rank
+        return tuple(
+            s for s in range(self.subnet_count) if self.owner(s) == r
+        )
+
+    # -- host eviction / rebalance ------------------------------------------
+
+    def evict_host(self, rank: int) -> int | None:
+        """Drop a host from the active set and rebalance its subnets
+        onto the survivors (HRW: only the evicted host's subnets move).
+        Returns the number of subnets that moved, or None when the
+        eviction is a no-op (unknown/already-evicted rank, last host)."""
+        with self._lock:
+            active = [h for h in range(self.hosts) if h not in self._evicted]
+            if rank not in active or len(active) <= 1:
+                return None
+            before = {
+                s: max(active, key=lambda h: self._weight(s, h))
+                for s in range(self.subnet_count)
+            }
+            self._evicted.append(rank)
+            survivors = [h for h in active if h != rank]
+            moved = sum(
+                1
+                for s in range(self.subnet_count)
+                if before[s] != max(
+                    survivors, key=lambda h: self._weight(s, h)
+                )
+            )
+            self._rebalances += 1
+            self._subnets_moved += moved
+        if self.observer is not None:
+            self.observer.fleet_rebalance(moved)
+        logger.warning(
+            "fleet: host %d evicted from subnet routing — %d subnet(s) "
+            "rebalanced onto %d surviving host(s)",
+            rank, moved, len(survivors),
+        )
+        return moved
+
+    def readmit_hosts(self) -> int:
+        """Restore every evicted host to the routing table (canary
+        passed). Returns the number of hosts re-admitted."""
+        with self._lock:
+            n = len(self._evicted)
+            if not n:
+                return 0
+            self._evicted = []
+            self._rebalances += 1
+        if self.observer is not None:
+            self.observer.fleet_rebalance(0)
+        logger.info("fleet: %d host(s) re-admitted to subnet routing", n)
+        return n
+
+    def record_foreign(self, subnet: int) -> None:
+        """Count an attestation seen for a subnet this host does NOT own
+        (gossip overlap — dropped before validation/BLS)."""
+        with self._lock:
+            self._foreign_dropped += 1
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            evicted = list(self._evicted)
+            rebalances = self._rebalances
+            moved = self._subnets_moved
+            foreign = self._foreign_dropped
+        owned = self.slice_for()
+        return {
+            "hosts": self.hosts,
+            "rank": self.rank,
+            "active_hosts": self.active_hosts(),
+            "evicted_hosts": evicted,
+            "subnet_count": self.subnet_count,
+            "owned_subnets": list(owned),
+            "owned": len(owned),
+            "rebalances": rebalances,
+            "subnets_moved": moved,
+            "foreign_dropped": foreign,
+        }

@@ -12,9 +12,17 @@ Where the reference scales BLS batch verification by chunking jobs across
   G2 point and one Fp12 element per chip), and the tiny cross-chip tail
   reduction plus the final exponentiation run replicated.
 
-DCN enters only if the mesh itself spans hosts — the same code compiles
-for a multi-host mesh because shard_map + all_gather are topology-agnostic
-(SURVEY.md §2.5 TPU-native plan).
+DCN enters when the mesh spans hosts (ROADMAP item 5, fleet serving):
+every kernel here also compiles over a TWO-LEVEL mesh — `axis` may be a
+tuple ``(dcn_axis, ici_axis)`` naming the outer cross-host axis and the
+inner within-host axis of a 2-D `Mesh`. The combines are then
+HIERARCHICAL and ICI-first: per-chip partials (Fp12 pair products, G2
+bit-plane sums) all_gather over ICI and reduce to ONE per-host value
+before a second all_gather crosses DCN — so the slow inter-host fabric
+carries one Fp12 element / 64 combined plane sums per HOST, never
+per-chip traffic. Per-chip Horner tails and Miller lanes stay ICI-local
+either way (pure data parallelism; the linear chip index is DCN-major,
+matching the `P((dcn, ici))` row sharding).
 """
 
 from __future__ import annotations
@@ -48,6 +56,68 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
     return _sm(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
     )
+
+
+def _axes(mesh_axis) -> tuple:
+    """Normalize an axis spec to a tuple of axis names: ``"dp"`` →
+    ``("dp",)``; a two-level ``("dcn", "ici")`` passes through with the
+    OUTER (cross-host) axis first — the same order as the 2-D Mesh's
+    axis_names and the `P((dcn, ici))` input sharding."""
+    return (mesh_axis,) if isinstance(mesh_axis, str) else tuple(mesh_axis)
+
+
+def _one_axis_size(name):
+    # lax.axis_size is newer-jax; psum(1, axis) is the 0.4.x idiom (static)
+    return (
+        lax.axis_size(name) if hasattr(lax, "axis_size")
+        else lax.psum(1, name)
+    )
+
+
+def _mesh_size(mesh_axis):
+    """Total chip count across all (1 or 2) mesh axes."""
+    n = 1
+    for name in _axes(mesh_axis):
+        n = n * _one_axis_size(name)
+    return n
+
+
+def _mesh_index(mesh_axis):
+    """This chip's linear index over the (possibly two-level) mesh,
+    row-major with the DCN axis slowest — matching the `P((dcn, ici))`
+    row sharding, so chip k owns global row-block k. Index 0 (host 0,
+    chip 0) is the root-tail owner."""
+    idx = 0
+    for name in _axes(mesh_axis):
+        idx = idx * _one_axis_size(name) + lax.axis_index(name)
+    return idx
+
+
+def _gather_fp12_partials(f_loc, mesh_axis):
+    """Gather the per-chip Fp12 pair-product partials for the root tail.
+    Single-level: one all_gather over ICI → (ndev, …). Two-level: gather
+    over ICI first and reduce to the per-host product, so DCN carries
+    exactly ONE Fp12 element per host → (hosts, …)."""
+    axes = _axes(mesh_axis)
+    if len(axes) == 1:
+        return lax.all_gather(f_loc, axes[0])
+    dcn, ici = axes
+    f_host = _fp12_product_tree(lax.all_gather(f_loc, ici))
+    return lax.all_gather(f_host, dcn)
+
+
+def _combine_plane_sums(u_part, mesh_axis):
+    """Combine per-chip partial G2 bit-plane sums into the replicated
+    (64,) totals. Hierarchical and ICI-first on a two-level mesh: the
+    inner gather + tree_sum collapses each host to one set of 64 plane
+    sums before the outer (DCN) gather — per-host-combined sums are the
+    only plane traffic that crosses hosts."""
+    u = u_part
+    for name in reversed(_axes(mesh_axis)):
+        u_all = tuple(lax.all_gather(c, name) for c in u)  # (n, 64, …)
+        u_all = tuple(jnp.moveaxis(c, 0, 1) for c in u_all)  # (64, n, …)
+        u = msm.tree_sum(g2, u_all)
+    return u
 from ..ops.pairing import (
     final_exponentiation_one,
     miller_loop_proj_pq,
@@ -111,24 +181,34 @@ def _tail_on_root(mesh_axis, tail_fn):
     "devices" share host cores (round-3 MESH_SCALING regressed 145 → 66
     sets/s from exactly this). Chip 0 computes, the rest contribute a
     zero to the psum — the reference's analog is the main thread owning
-    aggregation while workers verify (`chain/bls/multithread/index.ts`)."""
-    is_root = lax.axis_index(mesh_axis) == 0
+    aggregation while workers verify (`chain/bls/multithread/index.ts`).
+
+    On a two-level mesh the root is linear chip 0 = (host 0, chip 0) and
+    the verdict psum spans both axes (ICI then DCN) — one int32 per host
+    crosses DCN."""
+    is_root = _mesh_index(mesh_axis) == 0
     verdict_int = lax.cond(
         is_root,
         lambda _: tail_fn().astype(jnp.int32),
         lambda _: jnp.int32(0),
         operand=None,
     )
-    return lax.psum(verdict_int, mesh_axis) > 0
+    return lax.psum(verdict_int, _axes(mesh_axis)) > 0
 
 
 def _sharded_verify(mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
     f_loc, s_part = _local_body(
         pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid
     )
-    # ICI: gather per-chip partials (1 Fp12 + 1 projective G2 point each)
-    f_all = lax.all_gather(f_loc, mesh_axis)          # (ndev, 2,3,2,32)
-    s_all = jax.tree.map(lambda x: lax.all_gather(x, mesh_axis), s_part)
+    # gather per-chip partials (1 Fp12 + 1 projective G2 point each);
+    # ICI-first on a two-level mesh so only per-host combines cross DCN
+    f_all = _gather_fp12_partials(f_loc, mesh_axis)
+    axes = _axes(mesh_axis)
+    s_all = s_part
+    for i, name in enumerate(reversed(axes)):
+        s_all = jax.tree.map(lambda x, _n=name: lax.all_gather(x, _n), s_all)
+        if i < len(axes) - 1:
+            s_all = _g2_sum_tree(s_all)
 
     def tail():
         s = _g2_sum_tree(s_all)
@@ -147,7 +227,7 @@ def _sharded_verify(mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, v
     return _tail_on_root(mesh_axis, tail)
 
 
-def make_sharded_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded batch-verify over `mesh`. Batch axis 0 of every
     input must be divisible by the mesh size."""
     spec = P(axis)
@@ -188,12 +268,7 @@ def _grouped_local(
     them because the C tier subgroup-checks on the host."""
     r_loc, lanes = pk_x.shape[0], pk_x.shape[1]
     n_loc = r_loc * lanes
-    # lax.axis_size is newer-jax; psum(1, axis) is the 0.4.x idiom (static)
-    ndev = (
-        lax.axis_size(mesh_axis)
-        if hasattr(lax, "axis_size")
-        else lax.psum(1, mesh_axis)
-    )
+    ndev = _mesh_size(mesh_axis)
 
     pk = (pk_x, pk_y, fp.one((r_loc, lanes)))
     pk = g1.select(valid, pk, g1.infinity((r_loc, lanes)))
@@ -214,17 +289,13 @@ def _grouped_local(
     )
     sig = g2.select(valid.reshape(n_loc), sig, g2.infinity((n_loc,)))
     u_part = msm.masked_plane_sums(g2, sig, bits.reshape(n_loc, 2 * HALF_BITS))
-    u_all = tuple(
-        lax.all_gather(c, mesh_axis) for c in u_part
-    )  # (ndev, 64, …)
-    u_all = tuple(jnp.moveaxis(c, 0, 1) for c in u_all)  # (64, ndev, …)
-    u_planes = msm.tree_sum(g2, u_all)  # (64,) combined over chips
+    u_planes = _combine_plane_sums(u_part, mesh_axis)  # (64,) over all chips
     u_a = tuple(c[:HALF_BITS] for c in u_planes)
     u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
 
-    # this chip's slice of the 64 constant lanes
+    # this chip's slice of the 64 constant lanes (linear index: DCN-major)
     per = (2 * HALF_BITS) // ndev
-    start = lax.axis_index(mesh_axis) * per
+    start = _mesh_index(mesh_axis) * per
     uq = tuple(
         jnp.concatenate([ca, cb], 0) for ca, cb in zip(u_a, u_b)
     )  # (64,) Q lanes in plane order
@@ -253,7 +324,7 @@ def _grouped_local(
 
 def _sharded_grouped_verify(mesh_axis, *args):
     f_loc, _ = _grouped_local(mesh_axis, *args)
-    f_all = lax.all_gather(f_loc, mesh_axis)  # (ndev, 2,3,2,32)
+    f_all = _gather_fp12_partials(f_loc, mesh_axis)  # (ndev|hosts, 2,3,2,32)
 
     def tail():
         with named_scope("bls/final_exp_batch"):
@@ -281,8 +352,10 @@ def _sharded_grouped_raw_verify(
         mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y,
         a_bits, b_bits, valid & dec_ok,
     )
-    f_all = lax.all_gather(f_loc, mesh_axis)
-    decode_fail = lax.psum(fail_loc.astype(jnp.int32), mesh_axis) > 0
+    f_all = _gather_fp12_partials(f_loc, mesh_axis)
+    decode_fail = (
+        lax.psum(fail_loc.astype(jnp.int32), _axes(mesh_axis)) > 0
+    )
 
     def tail():
         with named_scope("bls/final_exp_batch"):
@@ -296,7 +369,7 @@ def _sharded_grouped_raw_verify(
     return _tail_on_root(mesh_axis, tail) & ~decode_fail
 
 
-def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_grouped_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded grouped batch-verify over `mesh`. The root
     axis (axis 0 of pk/msg/sig/bits/valid) must be divisible by the mesh
     size, and the mesh size must divide 64 (the constant-lane count)."""
@@ -322,7 +395,7 @@ def make_sharded_grouped_verifier(mesh: Mesh, axis: str = "dp"):
     return run
 
 
-def make_sharded_grouped_local_probe(mesh: Mesh, axis: str = "dp"):
+def make_sharded_grouped_local_probe(mesh: Mesh, axis: str | tuple = "dp"):
     """INSTRUMENTATION ONLY (tools/mesh_scaling.py): the sharded grouped
     kernel cut after the per-chip local body — MSMs, Horner, the u-plane
     all_gather and per-chip Miller lanes — with the root tail (cross-chip
@@ -350,7 +423,7 @@ def make_sharded_grouped_local_probe(mesh: Mesh, axis: str = "dp"):
     return run
 
 
-def make_sharded_grouped_raw_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_grouped_raw_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded grouped RAW batch-verify over `mesh`:
     signatures enter as (R, L, 96) wire bytes, root-sharded like every
     other input, and decompress on their owning chip. Same divisibility
@@ -379,7 +452,7 @@ class ShardedGroupedVerifier:
     """Host wrapper for the sharded grouped kernel: places (R, L) grouped
     arrays root-sharded onto the mesh."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp"):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size
@@ -407,7 +480,7 @@ class ShardedGroupedRawVerifier:
     conversion; `device_put` with the row sharding is the only host
     touch before the mesh decodes."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp"):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size
@@ -443,11 +516,7 @@ def _pk_grouped_local(
     64/n per chip."""
     r_loc, lanes = msg_x.shape[0], msg_x.shape[1]
     n_loc = r_loc * lanes
-    ndev = (
-        lax.axis_size(mesh_axis)
-        if hasattr(lax, "axis_size")
-        else lax.psum(1, mesh_axis)
-    )
+    ndev = _mesh_size(mesh_axis)
 
     msgs = (msg_x, msg_y, fp2.one((r_loc, lanes)))
     msgs = g2.select(valid, msgs, g2.infinity((r_loc, lanes)))
@@ -468,14 +537,12 @@ def _pk_grouped_local(
     )
     sig = g2.select(valid.reshape(n_loc), sig, g2.infinity((n_loc,)))
     u_part = msm.masked_plane_sums(g2, sig, bits.reshape(n_loc, 2 * HALF_BITS))
-    u_all = tuple(lax.all_gather(c, mesh_axis) for c in u_part)
-    u_all = tuple(jnp.moveaxis(c, 0, 1) for c in u_all)  # (64, ndev, …)
-    u_planes = msm.tree_sum(g2, u_all)
+    u_planes = _combine_plane_sums(u_part, mesh_axis)
     u_a = tuple(c[:HALF_BITS] for c in u_planes)
     u_b = g2_psi(tuple(c[HALF_BITS:] for c in u_planes))
 
     per = (2 * HALF_BITS) // ndev
-    start = lax.axis_index(mesh_axis) * per
+    start = _mesh_index(mesh_axis) * per
     uq = tuple(jnp.concatenate([ca, cb], 0) for ca, cb in zip(u_a, u_b))
     uq_loc = tuple(
         lax.dynamic_slice_in_dim(c, start, per, axis=0) for c in uq
@@ -500,7 +567,7 @@ def _pk_grouped_local(
 
 def _sharded_pk_grouped_verify(mesh_axis, *args):
     f_loc, _ = _pk_grouped_local(mesh_axis, *args)
-    f_all = lax.all_gather(f_loc, mesh_axis)
+    f_all = _gather_fp12_partials(f_loc, mesh_axis)
 
     def tail():
         with named_scope("bls/final_exp_batch"):
@@ -521,8 +588,10 @@ def _sharded_pk_grouped_raw_verify(
         mesh_axis, pk_x, pk_y, msg_x, msg_y, sig_x, sig_y,
         a_bits, b_bits, valid & dec_ok,
     )
-    f_all = lax.all_gather(f_loc, mesh_axis)
-    decode_fail = lax.psum(fail_loc.astype(jnp.int32), mesh_axis) > 0
+    f_all = _gather_fp12_partials(f_loc, mesh_axis)
+    decode_fail = (
+        lax.psum(fail_loc.astype(jnp.int32), _axes(mesh_axis)) > 0
+    )
 
     def tail():
         with named_scope("bls/final_exp_batch"):
@@ -534,7 +603,7 @@ def _sharded_pk_grouped_raw_verify(
     return _tail_on_root(mesh_axis, tail) & ~decode_fail
 
 
-def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded pk-grouped batch-verify over `mesh`. The
     pubkey-row axis must be divisible by the mesh size, and the mesh size
     must divide 64 (the constant-lane count)."""
@@ -558,7 +627,7 @@ def make_sharded_pk_grouped_verifier(mesh: Mesh, axis: str = "dp"):
     return run
 
 
-def make_sharded_pk_grouped_raw_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_pk_grouped_raw_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded pk-grouped RAW batch-verify over `mesh`:
     signatures enter as (R, L, 96) wire bytes and decompress on their
     owning chip. Same divisibility contract as the limb maker."""
@@ -586,7 +655,7 @@ class ShardedPkGroupedVerifier:
     """Host wrapper for the sharded pk-grouped kernel: places (R,) pubkey
     rows + (R, L) message/signature arrays row-sharded onto the mesh."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp"):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size
@@ -609,7 +678,7 @@ class ShardedPkGroupedRawVerifier:
     """Host wrapper for the sharded pk-grouped RAW kernel (wire-byte
     signatures; see `ShardedGroupedRawVerifier`)."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp"):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size
@@ -655,10 +724,15 @@ def _bisect_local(pk_x, pk_y, msg_x, msg_y, sig_x, sig_y, r_bits, valid):
 
 def _sharded_bisect_verify(mesh_axis, *args):
     f_loc = _bisect_local(*args)
-    # ICI: one Fp12 element per leaf per chip; the gather reconstructs the
-    # host's set order (shard k owns rows [k·n/ndev, (k+1)·n/ndev))
-    f_all = lax.all_gather(f_loc, mesh_axis)
-    leaves = f_all.reshape((-1,) + f_all.shape[2:])
+    # one Fp12 element per leaf per chip; the gathers reconstruct the
+    # host's set order (linear chip k owns rows [k·n/ndev, (k+1)·n/ndev)
+    # — ICI gathered first, then DCN, matching the DCN-major row
+    # sharding; bisect is the audit path, so full leaves crossing DCN on
+    # a two-level mesh is acceptable, unlike the hot grouped kernels)
+    leaves = f_loc
+    for name in reversed(_axes(mesh_axis)):
+        leaves = lax.all_gather(leaves, name)
+        leaves = leaves.reshape((-1,) + leaves.shape[2:])
     n = leaves.shape[0]
 
     # the product tree + root final exp are the latency-bound tail; run
@@ -688,14 +762,14 @@ def _sharded_bisect_verify(mesh_axis, *args):
             jnp.zeros((m,) + leaves.shape[1:], leaves.dtype) for m in shapes
         )
 
-    is_root = lax.axis_index(mesh_axis) == 0
+    is_root = _mesh_index(mesh_axis) == 0
     root_int, upper = lax.cond(is_root, tree, idle, operand=None)
-    root_int = lax.psum(root_int, mesh_axis)
-    upper = tuple(lax.psum(u, mesh_axis) for u in upper)
+    root_int = lax.psum(root_int, _axes(mesh_axis))
+    upper = tuple(lax.psum(u, _axes(mesh_axis)) for u in upper)
     return root_int > 0, (leaves,) + upper
 
 
-def make_sharded_bisect_verifier(mesh: Mesh, axis: str = "dp"):
+def make_sharded_bisect_verifier(mesh: Mesh, axis: str | tuple = "dp"):
     """jit-compiled sharded bisection-tree kernel over `mesh`. The batch
     size must be a power of two (the single-device kernel pads internally;
     here the HOST must pad before sharding so slices stay uniform) and
@@ -725,7 +799,7 @@ class ShardedBisectVerifier:
     padded per-set arrays lane-sharded onto the mesh. Batch size must be
     a power of two divisible by the mesh size."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp"):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp"):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size
@@ -747,7 +821,7 @@ class ShardedBlsVerifier:
     """Host wrapper: places padded batches onto the mesh and runs the
     sharded kernel. Lane count = bucket per chip × mesh size."""
 
-    def __init__(self, mesh: Mesh, axis: str = "dp", lanes_per_chip: int = 16):
+    def __init__(self, mesh: Mesh, axis: str | tuple = "dp", lanes_per_chip: int = 16):
         self.mesh = mesh
         self.axis = axis
         self.ndev = mesh.devices.size

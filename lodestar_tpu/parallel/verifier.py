@@ -965,6 +965,23 @@ class TpuBlsVerifier:
     def mesh_snapshot(self):
         return None if self._mesh is None else self._mesh.snapshot()
 
+    def mesh_evict_host(self, host: int | None = None,
+                        reason: str = "failure"):
+        """Evict a whole host from the two-level serving fleet; None when
+        no mesh / single-host census / nothing left to evict."""
+        if self._mesh is None:
+            return None
+        return self._mesh.evict_host(host=host, reason=reason)
+
+    def fleet_snapshot(self):
+        return None if self._mesh is None else self._mesh.fleet_snapshot()
+
+    def fleet_attach_router(self, router) -> None:
+        """Bind the FleetRouter so host evictions rebalance its subnet
+        slices (node wiring; no-op without a mesh)."""
+        if self._mesh is not None:
+            self._mesh.attach_router(router)
+
     # -- host marshalling ---------------------------------------------------
 
     def _native_eligible(self, sets) -> bool:

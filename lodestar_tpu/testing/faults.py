@@ -31,6 +31,12 @@ Modes (comma-separated, each with an optional ``:param``):
                        the supervisor must evict it from the serving mesh
                        and keep serving on the survivors (the eviction is
                        visible in the lodestar_bls_mesh_* families)
+    host[:rank]        raise InjectedHostFault(rank) on the next FLEET
+                       (multi-host) dispatch, then disarm (ONE-SHOT) — a
+                       sick host; the supervisor must evict it, the
+                       FleetRouter rebalances its subnets, and serving
+                       continues on the surviving hosts
+                       (lodestar_bls_fleet_* families)
     flaky[:rate]       corrupt verdicts: True -> False with probability
                        `rate` (default 1.0). One-directional by design:
                        random hardware corruption yields a pairing
@@ -69,12 +75,25 @@ class InjectedChipFault(InjectedFault):
         self.chip = chip
 
 
+class InjectedHostFault(InjectedFault):
+    """Synthetic WHOLE-HOST failure on a two-level fleet dispatch:
+    carries the sick host's rank so the supervisor's host-eviction
+    policy can attribute it (the chip-fault shape one level up).
+    Subclasses InjectedFault for the same reason InjectedChipFault
+    does: tierless handlers still catch it."""
+
+    def __init__(self, host: int):
+        super().__init__(f"injected host fault (host {host})")
+        self.host = host
+
+
 _MODE_DEFAULTS = {
     "exception": 1.0,   # probability
     "latency": 0.05,    # seconds
     "deadline": 30.0,   # seconds
     "flaky": 1.0,       # probability
     "chip": 0.0,        # chip index (mesh dispatch; ONE-SHOT)
+    "host": 0.0,        # host rank (fleet dispatch; ONE-SHOT)
 }
 
 _lock = threading.Lock()
@@ -111,10 +130,10 @@ def _parse(spec: str) -> dict[str, float]:
                     f"fault mode {name!r}: parameter must be >= 0, "
                     f"got {param!r}"
                 )
-            if name == "chip" and not value.is_integer():
+            if name in ("chip", "host") and not value.is_integer():
                 raise ValueError(
-                    "fault mode 'chip': parameter must be an integer chip "
-                    f"index, got {param!r}"
+                    f"fault mode {name!r}: parameter must be an integer "
+                    f"{name} index, got {param!r}"
                 )
         plan[name] = value
     return plan
@@ -196,6 +215,24 @@ def on_mesh_dispatch(mesh_size: int) -> None:
         chip = int(_plan.pop("chip"))
         _injected["chip"] = _injected.get("chip", 0) + 1
     raise InjectedChipFault(chip)
+
+
+def on_fleet_dispatch(hosts) -> None:
+    """Called by the mesh dispatcher before every TWO-LEVEL (multi-host)
+    dispatch. The `host[:rank]` mode raises InjectedHostFault(rank)
+    exactly ONCE and then disarms itself — same one-shot contract as
+    `chip`: a sick host is a persistent condition handled by eviction,
+    so after the supervisor evicts it, dispatches on the surviving
+    fleet must succeed (the host-eviction drill)."""
+    plan = _plan
+    if plan is None or "host" not in plan:
+        return
+    with _lock:
+        if _plan is None or "host" not in _plan:
+            return
+        host = int(_plan.pop("host"))
+        _injected["host"] = _injected.get("host", 0) + 1
+    raise InjectedHostFault(host)
 
 
 def flaky_verdict(verdict: bool) -> bool:

@@ -125,6 +125,22 @@ _register("LODESTAR_TPU_MARSHAL_THREADS", "int", None,
 _register("LODESTAR_TPU_MESH", "str", "auto",
           "Mesh serving policy: auto (multi-chip hardware only), force "
           "(any >1-device backend, incl. virtual CPU meshes), off.")
+_register("LODESTAR_TPU_FLEET", "str", None,
+          "Fleet (multi-host) serving: unset/off = single host; "
+          "'host:port' names the jax.distributed coordinator (real "
+          "multi-process fleet); 'emulate' splits the local devices "
+          "into virtual hosts (CPU parity dryruns). Engages only when "
+          "mesh serving itself is enabled (LODESTAR_TPU_MESH).")
+_register("LODESTAR_TPU_FLEET_HOSTS", "int", 2,
+          "Fleet host count: jax.distributed process count "
+          "(distributed mode) or virtual-host count (emulate mode).")
+_register("LODESTAR_TPU_FLEET_RANK", "int", 0,
+          "This process's host rank in [0, FLEET_HOSTS); rank 0 owns "
+          "the root tail of two-level dispatches.")
+_register("LODESTAR_TPU_FLEET_INGEST", "bool", True,
+          "When the fleet is active, drop gossip attestations whose "
+          "subnet the FleetRouter assigns to another host (each host's "
+          "lanes see only its slice); off validates everything locally.")
 _register("LODESTAR_TPU_WAITER_TIMEOUT", "float", 300.0,
           "Seconds a buffered-verifier waiter blocks on the flush "
           "thread before escalating and failing the call.")

@@ -117,6 +117,8 @@ def test_peerstore_persists_across_restart(tmp_path):
     table on restart (reference peer datastore persistence)."""
     import asyncio
 
+    pytest.importorskip("cryptography")  # discovery identities need it
+
     from lodestar_tpu.cli.beacon import _load_peerstore, _save_peerstore
     from lodestar_tpu.network.discovery import ENR, Discovery
     from lodestar_tpu.network.transport import NodeIdentity

@@ -228,6 +228,33 @@ def test_mesh_raw_twin_submit_is_ledger_wrapped():
         assert v.submit.__compile_ledger_kernel__ == f"sharded_{kind}"
 
 
+def test_fleet_twin_submit_is_ledger_wrapped_with_host_key():
+    """ISSUE 20: two-level fleet verifiers record under their OWN kernel
+    names (``fleet_<kind>``) with the host count in the static key — the
+    same (kind, shape, chips) over 1 host vs 2 hosts is a different
+    executable and the AOT store must not conflate them."""
+    from lodestar_tpu.observability.compile_ledger import ledger
+    from lodestar_tpu.parallel.mesh import _ledger_wrap_submit
+
+    class _V:
+        def submit(self, *a):
+            return True
+
+    for kind in ("grouped", "grouped_raw", "pk_grouped",
+                 "pk_grouped_raw", "bisect"):
+        v = _V()
+        _ledger_wrap_submit(v, kind, (16, 8), (0, 1, 2, 3), hosts=2)
+        assert v.submit.__compile_ledger_kernel__ == f"fleet_{kind}"
+        assert v.submit() is True
+    events = [e for e in ledger().snapshot()["events"]
+              if e["kernel"].startswith("fleet_")]
+    assert {e["kernel"] for e in events} >= {
+        "fleet_grouped", "fleet_bisect"
+    }
+    for e in events:
+        assert "@hosts2" in e["key"]
+
+
 # -- flight recorder --------------------------------------------------------
 
 

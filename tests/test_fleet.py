@@ -1,0 +1,403 @@
+"""Fleet-serving policy tests (ISSUE 20): two-level dispatcher state
+machine, subnet router, host fault injection, and the supervisor's
+host-eviction ladder.
+
+Everything here is HOST-side policy — fleet layout sizing, host
+eviction/re-admission, verifier-cache keying by host set, rendezvous
+subnet routing, the exhaust-to-CPU-oracle ladder — driven with stub
+verifier factories and fake devices so no kernel ever compiles (the
+two-level collective math itself is proven by tools/dryrun_fleet.py and
+the slow sharded tier)."""
+
+import pytest
+
+from lodestar_tpu.chain.supervisor import SupervisedBlsVerifier
+from lodestar_tpu.observability.stages import PipelineMetrics
+from lodestar_tpu.parallel.fleet import FleetRouter, FleetTopology
+from lodestar_tpu.parallel.mesh import BlsMeshDispatcher
+from lodestar_tpu.testing import faults
+from lodestar_tpu.testing.faults import InjectedHostFault
+
+SUBNETS = 64
+
+
+class _FakeGrouped:
+    class _Arr:
+        def __init__(self, shape):
+            self.shape = shape
+
+    def __init__(self, rows, lanes):
+        self.pk_x = self._Arr((rows, lanes))
+        self.msg_x = self._Arr((rows, lanes))
+
+
+class _StubVerifier:
+    def __init__(self, kind, devices, axis):
+        self.kind = kind
+        self.devices = devices
+        self.axis = axis
+        self.submits = 0
+
+    def submit(self, *args):
+        self.submits += 1
+        return True
+
+
+def _factory_recorder(calls):
+    def factory(kind, devices, axis):
+        v = _StubVerifier(kind, devices, axis)
+        calls.append(v)
+        return v
+
+    return factory
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear(reset_counters=True)
+    yield
+    faults.clear(reset_counters=True)
+
+
+def _fleet_dispatcher(host_widths=(4, 4), observer=None, calls=None,
+                      router=None):
+    calls = calls if calls is not None else []
+    devices, hosts, i = [], [], 0
+    for w in host_widths:
+        hosts.append(list(range(i, i + w)))
+        devices.extend(f"dev{j}" for j in range(i, i + w))
+        i += w
+    return BlsMeshDispatcher(
+        devices,
+        observer=observer or PipelineMetrics(),
+        verifier_factory=_factory_recorder(calls),
+        hosts=hosts,
+        router=router,
+    )
+
+
+# -- FleetRouter: rendezvous subnet routing --------------------------------
+
+
+def test_router_deterministic_disjoint_covering():
+    r0 = FleetRouter(4, 0)
+    r1 = FleetRouter(4, 1)
+    # same host census => identical owner map on every rank
+    assert [r0.owner(s) for s in range(SUBNETS)] == [
+        r1.owner(s) for s in range(SUBNETS)
+    ]
+    slices = [r0.slice_for(h) for h in range(4)]
+    seen = [s for sl in slices for s in sl]
+    assert sorted(seen) == list(range(SUBNETS))  # covering + disjoint
+    assert all(len(sl) > 0 for sl in slices)  # no starved host
+    for h, sl in enumerate(slices):
+        assert all(r0.owner(s) == h and FleetRouter(4, h).owns(s)
+                   for s in sl)
+
+
+def test_router_eviction_moves_only_the_dead_hosts_subnets():
+    r = FleetRouter(4, 0)
+    before = {s: r.owner(s) for s in range(SUBNETS)}
+    dead = r.slice_for(2)
+    moved = r.evict_host(2)
+    assert moved == len(dead)
+    after = {s: r.owner(s) for s in range(SUBNETS)}
+    # rendezvous hashing: survivors keep every subnet they already owned
+    for s in range(SUBNETS):
+        if before[s] != 2:
+            assert after[s] == before[s]
+        else:
+            assert after[s] != 2
+    # re-admission restores the exact original map
+    assert r.readmit_hosts() == 1
+    assert {s: r.owner(s) for s in range(SUBNETS)} == before
+
+
+def test_router_eviction_edge_cases_and_snapshot():
+    r = FleetRouter(2, 1)
+    assert r.evict_host(7) is None  # unknown host: no-op
+    assert r.evict_host(0) is not None
+    assert r.evict_host(1) is None  # last serving host stays
+    r.record_foreign(3)
+    snap = r.snapshot()
+    assert snap["hosts"] == 2 and snap["rank"] == 1
+    assert snap["active_hosts"] == [1] and snap["evicted_hosts"] == [0]
+    assert snap["owned"] == SUBNETS
+    assert list(snap["owned_subnets"]) == list(range(SUBNETS))
+    assert snap["rebalances"] == 1 and snap["foreign_dropped"] == 1
+    assert snap["subnets_moved"] > 0
+    assert r.readmit_hosts() == 1 and r.snapshot()["evicted_hosts"] == []
+
+
+def test_router_rebalance_notifies_observer():
+    obs = PipelineMetrics()
+    r = FleetRouter(2, 0, observer=obs)
+    r.evict_host(1)
+    snap = obs.fleet_snapshot()
+    assert snap["rebalances"] == 1
+    assert snap["subnets_moved"] == len(FleetRouter(2, 1).slice_for(1))
+
+
+# -- FleetTopology: env parsing + device grouping --------------------------
+
+
+def test_topology_env_parsing(monkeypatch):
+    monkeypatch.delenv("LODESTAR_TPU_FLEET", raising=False)
+    assert FleetTopology.from_env().mode == "off"
+    monkeypatch.setenv("LODESTAR_TPU_FLEET", "emulate")
+    monkeypatch.setenv("LODESTAR_TPU_FLEET_HOSTS", "2")
+    topo = FleetTopology.from_env()
+    assert topo.mode == "emulate" and topo.active and topo.hosts == 2
+    monkeypatch.setenv("LODESTAR_TPU_FLEET", "coord-host:9777")
+    topo = FleetTopology.from_env()
+    assert topo.mode == "distributed"
+    assert topo.coordinator == "coord-host:9777"
+    # nonsense rank must degrade to off, never raise at node startup
+    monkeypatch.setenv("LODESTAR_TPU_FLEET_RANK", "5")
+    assert FleetTopology.from_env().mode == "off"
+
+
+def test_topology_emulate_groups_devices_contiguously():
+    topo = FleetTopology(mode="emulate", hosts=2, rank=0)
+    rows = topo.group_devices([f"d{i}" for i in range(8)])
+    assert rows == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert topo.group_devices(["d0"]) is None  # nothing to split
+    off = FleetTopology(mode="off")
+    assert off.group_devices([f"d{i}" for i in range(8)]) is None
+
+
+# -- two-level dispatcher: layout, cache, census ---------------------------
+
+
+def test_fleet_dispatch_routes_two_level_and_counts():
+    calls = []
+    obs = PipelineMetrics()
+    d = _fleet_dispatcher((4, 4), observer=obs, calls=calls)
+    assert d.size == 8 and d.hosts_serving == 2 and d.hosts_total == 2
+    g = _FakeGrouped(8, 64)
+    assert d.dispatch_grouped(g, None, None) is True
+    assert len(calls) == 1
+    # the factory saw per-host ROWS and the (dcn, ici) axis pair
+    assert calls[0].devices == [
+        ["dev0", "dev1", "dev2", "dev3"], ["dev4", "dev5", "dev6", "dev7"]
+    ]
+    assert calls[0].axis == (d.dcn_axis, d.ici_axis)
+    assert d.dispatch_grouped(g, None, None) is True
+    assert len(calls) == 1 and calls[0].submits == 2  # cached
+    snap = d.fleet_snapshot()
+    assert snap["hosts_serving"] == 2
+    assert snap["host_dispatches"] == {"0": 2, "1": 2}
+    assert obs.fleet_snapshot()["host_dispatches"] == {"0": 2, "1": 2}
+
+
+def test_fleet_layout_uniform_pow2_rows():
+    # ragged host widths: every row is trimmed to the SAME pow2 width
+    # (min across hosts) so the (hosts, chips) device grid is rectangular
+    calls = []
+    d = _fleet_dispatcher((4, 3), calls=calls)
+    assert d.size == 4  # 2 hosts x 2 chips
+    g = _FakeGrouped(8, 64)
+    assert d.dispatch_grouped(g, None, None) is True
+    assert calls[0].devices == [["dev0", "dev1"], ["dev4", "dev5"]]
+
+
+def test_fleet_verifier_cache_keyed_by_host_set():
+    calls = []
+    d = _fleet_dispatcher((2, 2), calls=calls)
+    g = _FakeGrouped(8, 64)
+    assert d.dispatch_grouped(g, None, None) is True
+    assert d.evict_host(1, reason="drill") is not None
+    assert d.dispatch_grouped(g, None, None) is True
+    d.readmit()
+    assert d.dispatch_grouped(g, None, None) is True
+    # two distinct host sets -> two compiles; the readmitted layout
+    # reuses the first verifier (cache hit, no third compile)
+    assert len(calls) == 2
+    assert calls[0].devices == [["dev0", "dev1"], ["dev2", "dev3"]]
+    assert calls[1].devices == ["dev0", "dev1"]  # single-host: flat
+    assert calls[1].axis == "dp"
+    assert calls[0].submits == 2 and calls[1].submits == 1
+
+
+def test_host_eviction_rebalances_and_readmit_restores():
+    obs = PipelineMetrics()
+    router = FleetRouter(2, 0, observer=obs)
+    d = _fleet_dispatcher((4, 4), observer=obs, router=router)
+    moved_expected = len(router.slice_for(1))
+    assert d.evict_host(1, reason="drill") == 4
+    assert d.hosts_serving == 1 and d.has_evicted()
+    assert router.snapshot()["active_hosts"] == [0]
+    snap = d.fleet_snapshot()
+    assert snap["evicted_hosts"] == [{"host": 1, "reason": "drill"}]
+    assert snap["router"]["subnets_moved"] == moved_expected
+    counters = obs.fleet_snapshot()
+    assert counters["host_evictions"] == {"drill": 1}
+    assert counters["subnets_moved"] == moved_expected
+    # readmission restores the full fleet AND the router census
+    assert d.readmit() == 1
+    assert d.hosts_serving == 2 and not d.has_evicted()
+    assert router.snapshot()["evicted_hosts"] == []
+
+
+def test_host_eviction_edge_cases():
+    d = _fleet_dispatcher((4, 4))
+    assert d.evict_host(1) == 4
+    assert d.evict_host() is None  # last serving host stays
+    single = BlsMeshDispatcher(
+        [f"dev{i}" for i in range(4)],
+        observer=PipelineMetrics(),
+        verifier_factory=_factory_recorder([]),
+    )
+    assert single.evict_host() is None  # single-host census: no-op
+    assert single.fleet_snapshot() is None  # /debug/fleet -> wired: false
+
+
+def test_unattributed_host_eviction_keeps_root_host():
+    # host 0 owns the two-level root tail: default eviction must drop
+    # the highest-rank active host, never host 0
+    d = _fleet_dispatcher((2, 2, 2, 2))
+    assert d.hosts_serving == 4
+    d.evict_host()
+    d.evict_host()
+    snap = d.fleet_snapshot()
+    assert [e["host"] for e in snap["evicted_hosts"]] == [3, 2]
+    assert d.hosts_serving == 2
+
+
+def test_host_fault_is_one_shot_and_attributed():
+    faults.configure("host:1")
+    d = _fleet_dispatcher((2, 2))
+    g = _FakeGrouped(8, 64)
+    with pytest.raises(InjectedHostFault) as exc:
+        d.dispatch_grouped(g, None, None)
+    assert exc.value.host == 1
+    # one-shot: the plan disarmed itself, the next dispatch serves
+    assert d.dispatch_grouped(g, None, None) is True
+    assert faults.snapshot()["injected"]["host"] == 1
+
+
+# -- supervisor: host-eviction ladder --------------------------------------
+
+
+class _FakeFleetDevice:
+    """Device facade over a 2x2 fleet dispatcher whose scripted failures
+    raise attributed host faults; mirrors the mesh_* surface the
+    supervisor duck-types (verifier.py passthroughs)."""
+
+    def __init__(self, fail_hosts=(1,), router=None):
+        self._pending = list(fail_hosts)
+        self.dispatcher = _fleet_dispatcher((2, 2), router=router)
+        self.calls = 0
+
+    def verify_signature_sets(self, sets):
+        self.calls += 1
+        if self._pending:
+            raise InjectedHostFault(self._pending.pop(0))
+        return True
+
+    def mesh_evict(self, chip=None, reason="failure"):
+        return self.dispatcher.evict(chip=chip, reason=reason)
+
+    def mesh_evict_host(self, host=None, reason="failure"):
+        return self.dispatcher.evict_host(host=host, reason=reason)
+
+    def mesh_readmit(self):
+        return self.dispatcher.readmit()
+
+    def mesh_has_evicted(self):
+        return self.dispatcher.has_evicted()
+
+    def mesh_snapshot(self):
+        return self.dispatcher.snapshot()
+
+    def fleet_snapshot(self):
+        return self.dispatcher.fleet_snapshot()
+
+
+class _FakeCpu:
+    def __init__(self):
+        self.calls = 0
+
+    def verify_signature_sets(self, sets):
+        self.calls += 1
+        return True
+
+    def verify_signature_sets_individual(self, sets):
+        self.calls += 1
+        return [True] * len(sets)
+
+
+def _supervised(device, **kw):
+    return SupervisedBlsVerifier(
+        device,
+        _FakeCpu(),
+        observer=PipelineMetrics(),
+        deadline_s=0,
+        canary_thread=False,
+        **kw,
+    )
+
+
+def test_supervisor_evicts_sick_host_and_keeps_serving():
+    router = FleetRouter(2, 0)
+    device = _FakeFleetDevice(fail_hosts=(1,), router=router)
+    sup = _supervised(device)
+    assert sup.verify_signature_sets([object()]) is True
+    # the host fault cost one eviction + immediate retry: no CPU
+    # fallback, no transient retry, no breaker feed
+    assert device.calls == 2
+    assert sup.cpu.calls == 0
+    assert sup.breaker_state == "closed"
+    assert sup._consecutive_failures == 0
+    snap = device.fleet_snapshot()
+    assert snap["evicted_hosts"] == [
+        {"host": 1, "reason": "InjectedHostFault"}
+    ]
+    assert snap["hosts_serving"] == 1
+    # the drill's other half: the router rebalanced the dead host's slice
+    assert snap["router"]["active_hosts"] == [0]
+    assert snap["router"]["subnets_moved"] > 0
+
+
+def test_supervisor_host_eviction_does_not_burn_retry_budget():
+    # host fault then chip fault: two eviction retries, more than the
+    # 1-retry transient budget — all absorbed without the CPU oracle
+    from lodestar_tpu.testing.faults import InjectedChipFault
+
+    device = _FakeFleetDevice(fail_hosts=(1,))
+    device._pending = [InjectedHostFault(1), InjectedChipFault(0)]
+
+    def scripted(sets):
+        device.calls += 1
+        if device._pending:
+            raise device._pending.pop(0)
+        return True
+
+    device.verify_signature_sets = scripted
+    sup = _supervised(device)
+    assert sup.verify_signature_sets([object()]) is True
+    assert device.calls == 3
+    assert sup.cpu.calls == 0
+
+
+def test_supervisor_falls_back_once_fleet_exhausted():
+    # every dispatch raises host faults: the first eviction drops host 1,
+    # then (host 0 unevictable — last one serving) the CHIP ladder
+    # absorbs what it can, and only once both tiers are exhausted does
+    # the ordinary failure policy take over (retry, then CPU oracle)
+    device = _FakeFleetDevice(fail_hosts=(1, 0, 0, 0, 0, 0))
+    sup = _supervised(device)
+    assert sup.verify_signature_sets([object()]) is True
+    assert sup.cpu.calls == 1
+    assert device.dispatcher.hosts_serving == 1
+
+
+def test_supervisor_probe_readmits_evicted_hosts():
+    device = _FakeFleetDevice(fail_hosts=(1,))
+    sup = _supervised(device)
+    assert sup.verify_signature_sets([object()]) is True
+    assert device.mesh_has_evicted()
+    sup._canary_sets = [object()]
+    assert sup.probe() is True
+    assert not device.mesh_has_evicted()
+    assert device.dispatcher.hosts_serving == 2

@@ -5,6 +5,10 @@ import json
 
 import pytest
 
+# EIP-2335 keystores (scrypt/AES) need the `cryptography` wheel, which
+# minimal CI images may lack — skip, not error
+pytest.importorskip("cryptography")
+
 from lodestar_tpu.api.keymanager import create_keymanager_server
 from lodestar_tpu.bls import api as bls
 from lodestar_tpu.config.beacon_config import BeaconConfig

@@ -107,6 +107,10 @@ def test_network_metrics_exported_live():
     counters move with real traffic (reference: gossipsub metric family)."""
     import asyncio
 
+    import pytest
+
+    pytest.importorskip("cryptography")  # live transport identities
+
     from lodestar_tpu.metrics import create_beacon_metrics
     from lodestar_tpu.network.network import Network
     from lodestar_tpu.network.transport import NodeIdentity

@@ -10,6 +10,10 @@ import asyncio
 
 import pytest
 
+# live networking (noise transport identities) needs the `cryptography`
+# wheel, which minimal CI images may lack — skip, not error
+pytest.importorskip("cryptography")
+
 from lodestar_tpu.network.network import Network
 from lodestar_tpu.network.transport import NodeIdentity
 

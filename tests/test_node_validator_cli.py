@@ -92,6 +92,8 @@ def test_external_signer_roundtrip(node_env):
 
 
 def test_keystore_roundtrip(tmp_path):
+    pytest.importorskip("cryptography")  # EIP-2335 scrypt/AES
+
     from lodestar_tpu.validator.keystore import (
         KeystoreError,
         decrypt_keystore,

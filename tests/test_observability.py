@@ -326,6 +326,41 @@ def test_metrics_server_debug_mesh_endpoint():
         server.close()
 
 
+def test_metrics_server_debug_fleet_endpoint():
+    """/debug/fleet serves the two-level fleet census when wired
+    (ISSUE 20), and reports wired:false on single-host/unmeshed nodes."""
+    import urllib.request
+
+    from lodestar_tpu.metrics import MetricsRegistry, MetricsServer
+
+    snap = {
+        "hosts_total": 2,
+        "hosts_serving": 2,
+        "layout": {"0": [0, 1], "1": [2, 3]},
+        "host_dispatches": {"0": 2, "1": 2},
+        "evicted_hosts": [],
+        "router": {"hosts": 2, "rank": 0, "owned": 29},
+    }
+    server = MetricsServer(MetricsRegistry(), port=0, fleet=lambda: snap)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/fleet"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": True, **snap}
+    finally:
+        server.close()
+
+    # single-host dispatchers return None from fleet_snapshot()
+    server = MetricsServer(MetricsRegistry(), port=0, fleet=lambda: None)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/debug/fleet"
+        with urllib.request.urlopen(url) as r:
+            assert json.load(r) == {"wired": False}
+    finally:
+        server.close()
+
+
 def test_metrics_server_debug_epoch_table_endpoint():
     """/debug/epoch_table serves the table snapshot when wired (ISSUE 18),
     reports wired:false when the table is disabled or absent, and maps a

@@ -9,6 +9,10 @@ import threading
 
 import pytest
 
+# live req/resp (noise transport identities) needs the `cryptography`
+# wheel, which minimal CI images may lack — skip, not error
+pytest.importorskip("cryptography")
+
 from lodestar_tpu.network.reqresp.handlers import ReqRespHandlers
 from lodestar_tpu.network.reqresp.service import RemotePeer, ReqRespService, RequestError
 from lodestar_tpu.network.transport import NodeIdentity, Transport

@@ -9,6 +9,10 @@ import asyncio
 
 import pytest
 
+# the simulation environment spins up live-networked nodes whose
+# transport identities need the `cryptography` wheel — skip, not error
+pytest.importorskip("cryptography")
+
 from lodestar_tpu.sim import SimulationAssertions, SimulationEnvironment
 
 # deep-kernel compiles / subprocess e2e: excluded from the default fast

@@ -9,6 +9,10 @@ import asyncio
 
 import pytest
 
+# secure transport (secp256k1 identities, noise) needs the
+# `cryptography` wheel, which minimal CI images may lack — skip, not error
+pytest.importorskip("cryptography")
+
 from lodestar_tpu.network.transport import (
     NodeIdentity,
     Transport,

@@ -94,6 +94,10 @@ REQUIRED_GATED_KEYS = (
     # a round whose serving-ready grew 3x regressed the restart story,
     # e.g. a broken AOT store silently degrading every boot to JIT)
     "serving_ready_seconds",
+    # ISSUE 20: the two-level fleet serving rate (the grouped kernel
+    # through the emulated 2-host (dcn, ici) mesh; absent history skips
+    # the gate, so pre-fleet rounds stay green)
+    "fleet_sets_per_sec",
 )
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
@@ -310,6 +314,12 @@ def _direction(key: str) -> str | None:
         return "up"
     if base.endswith(("_s", "_ms", "_seconds")):
         return "down"
+    if base == "fleet_overlap_fraction":
+        # ISSUE 20: retained-throughput fraction of the two-level mesh
+        # vs the flat mesh — a drop means the DCN collectives stopped
+        # overlapping (e.g. a hierarchy regression re-crossing DCN per
+        # bit-plane), which a raw rate row could hide behind faster chips
+        return "up"
     return None
 
 
@@ -366,6 +376,15 @@ def compare(prev: dict, curr: dict, threshold: float) -> tuple[list, list]:
         report.append((base, direction, p, c, ratio, regressed))
         if regressed:
             regressions.append(base)
+    # ISSUE 20: fleet parity is a hard acceptance bit, not a trend — a
+    # current round whose fleet_dryrun phase emitted fleet_parity_ok=0
+    # diverged two-level verdicts from the flat mesh and fails outright,
+    # whatever the rate rows say
+    parity = _find_by_base(curr["rows"], "fleet_parity_ok")
+    if parity is not None and parity[1] < 1:
+        regressions.append(
+            "fleet_parity_ok (two-level verdicts diverged from flat mesh)"
+        )
     return report, regressions
 
 

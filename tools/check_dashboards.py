@@ -110,6 +110,19 @@ REQUIRED_PANEL_METRICS = {
         "lodestar_tpu_device_memory_bytes",
         "lodestar_tpu_device_memory_watermark_bytes",
     ),
+    # fleet-serving families (ISSUE 20): the two-level (ICI x DCN) mesh
+    # is a cross-host concern, so its census belongs on the multinode
+    # comparison view — a fleet silently serving on fewer hosts (or a
+    # router rebalancing in a loop) must be visible per instance
+    "lodestar_tpu_multinode.json": (
+        "lodestar_bls_fleet_hosts",
+        "lodestar_bls_fleet_evicted_hosts",
+        "lodestar_bls_fleet_host_dispatch_total",
+        "lodestar_bls_fleet_dcn_collective_seconds_total",
+        "lodestar_bls_fleet_host_evictions_total",
+        "lodestar_bls_fleet_rebalances_total",
+        "lodestar_bls_fleet_subnets_moved_total",
+    ),
 }
 
 SLO_RULES_FILE = "slo_rules.json"

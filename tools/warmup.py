@@ -276,6 +276,46 @@ def warm_production(include_bench: bool, device_decompress: bool = True) -> None
             print(f"sharded-raw pk-grouped {rows}x{lanes} /{n_mesh}: "
                   f"{time.monotonic() - t0:.1f}s verdict={ok}", flush=True)
             timeline().mark(f"rung_sharded_raw_pk_{rows}x{lanes}")
+    # fleet two-level twins (ISSUE 20): when a fleet topology is active
+    # (LODESTAR_TPU_FLEET), the mesh dispatcher serves from a (dcn, ici)
+    # two-level shard_map — a DIFFERENT executable per host count than
+    # the flat single-host twins above, recorded under the fleet_*
+    # kernel names. Warm them through the dispatcher itself so the
+    # compile-ledger wrap (and --aot-export, which rides the ledger's
+    # AOT seam) covers exactly the production dispatch path.
+    from lodestar_tpu.parallel.fleet import FleetTopology
+
+    topo = FleetTopology.from_env()
+    host_rows = topo.group_devices(jax.devices()) if topo.active else None
+    if host_rows is not None:
+        from lodestar_tpu.parallel.mesh import NOT_SHARDED, BlsMeshDispatcher
+
+        disp = BlsMeshDispatcher(jax.devices(), hosts=host_rows)
+        if disp.hosts_serving > 1:
+            for rows, lanes in grouped:
+                if rows % disp.size:
+                    continue
+                g, a_bits, b_bits, sig_raw = _example_grouped(
+                    rows, lanes, raw=True
+                )
+                t0 = time.monotonic()
+                ok = disp.dispatch_grouped(g, a_bits, b_bits)
+                if ok is NOT_SHARDED:
+                    continue
+                print(f"fleet grouped {rows}x{lanes} "
+                      f"/{disp.hosts_serving}h: "
+                      f"{time.monotonic() - t0:.1f}s verdict={bool(ok)}",
+                      flush=True)
+                timeline().mark(f"rung_fleet_{rows}x{lanes}")
+                if device_decompress:
+                    t0 = time.monotonic()
+                    ok = disp.dispatch_grouped_raw(g, sig_raw, a_bits, b_bits)
+                    if ok is not NOT_SHARDED:
+                        print(f"fleet grouped raw {rows}x{lanes} "
+                              f"/{disp.hosts_serving}h: "
+                              f"{time.monotonic() - t0:.1f}s "
+                              f"verdict={bool(ok)}", flush=True)
+                        timeline().mark(f"rung_fleet_raw_{rows}x{lanes}")
     # the ladder is the serving contract: every production shape compiled
     # means a node restarting against this cache is serving-ready here
     t_ready = timeline().mark_serving_ready()
